@@ -1,0 +1,26 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet capabilities.
+
+Brand-new design on JAX/XLA/PJRT (see SURVEY.md at repo root for the blueprint
+and reference citations): NDArrays wrap PJRT buffers with async-future
+semantics, operators are jax-traceable functions compiled per (op, attrs,
+shapes), symbolic graphs lower to single XLA modules, and distributed data
+parallelism rides XLA collectives over ICI/DCN behind the kvstore API.
+
+Conventional usage mirrors MXNet:
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+    net = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=10)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, AttrScope, NameManager
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd

@@ -1,0 +1,311 @@
+"""Autograd: imperative differentiation on a recorded tape.
+
+Parity target: python/mxnet/autograd.py + src/imperative/imperative.cc
+(RecordOp :182, Backward :358). The reference records an nnvm graph via
+per-NDArray AGInfo and executes a gradient graph op-by-op. TPU-natively, the
+tape records (jax-traceable fn, inputs, outputs); `backward()` stitches the
+reachable subgraph into ONE pure function of the gradient-requiring variables
+and calls jax.vjp on it — the entire backward pass compiles to a single XLA
+module instead of a per-op interpreter loop.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    s = _st()
+    prev, s.recording = s.recording, is_record
+    return prev
+
+
+def set_training(train_mode: bool) -> bool:
+    s = _st()
+    prev, s.training = s.training, train_mode
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._is_record = is_record
+        self._train = train_mode
+
+    def __enter__(self):
+        s = _st()
+        self._prev = (s.recording, s.training)
+        if self._is_record is not None:
+            s.recording = self._is_record
+        if self._train is not None:
+            s.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        s = _st()
+        s.recording, s.training = self._prev
+
+
+def record(train_mode=True):
+    """Returns a scope that turns on recording (and train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class AGNode:
+    """One recorded op application (role of nnvm node + AGInfo,
+    include/mxnet/imperative.h:59-95)."""
+
+    __slots__ = ("fn", "inputs", "input_values", "n_out", "out_index_of")
+
+    def __init__(self, fn, inputs, input_values, n_out):
+        self.fn = fn                  # fn(*arrays) -> tuple of arrays
+        self.inputs = inputs          # list of AGEntry (node, idx) or var marker
+        self.input_values = input_values  # jax arrays captured at record time
+        self.n_out = n_out
+
+
+class AGVar:
+    """A leaf variable (NDArray with attach_grad or any un-recorded input)."""
+
+    __slots__ = ("nd", "value")
+
+    def __init__(self, nd, value):
+        self.nd = nd
+        self.value = value
+
+
+def _record(schema, attrs, rng, is_train, inputs, outputs, n_out):
+    from .imperative import jitted_for_schema
+    base = jitted_for_schema(schema, attrs, is_train)
+    if schema.needs_rng:
+        def fn(*arrays, _rng=rng, _base=base):
+            return _base(_rng, *arrays)
+    else:
+        fn = base
+    _record_fn(fn, inputs, outputs, n_out=n_out)
+
+
+def _record_fn(fn, inputs, outputs, n_out=None):
+    from .ndarray.ndarray import NDArray
+    entries = []
+    values = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            entries.append(x._ag_node)  # (AGNode, idx) or AGVar or None
+            values.append(x._data)
+        else:
+            entries.append(None)
+            values.append(x)
+    node = AGNode(fn, entries, values, n_out if n_out is not None else len(outputs))
+    for i, o in enumerate(outputs[:node.n_out]):
+        o._ag_node = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: mx.autograd.mark_variables (autograd.py:216)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._ag_node = AGVar(v, v._data)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _collect(heads):
+    """Topologically collect reachable AGNodes and leaf AGVars."""
+    nodes = []       # topo order (inputs before users)
+    seen = set()
+    variables = []   # AGVar leaves with grad attached
+    var_seen = set()
+
+    def visit(entry):
+        if entry is None:
+            return
+        if isinstance(entry, AGVar):
+            if id(entry) not in var_seen:
+                var_seen.add(id(entry))
+                variables.append(entry)
+            return
+        node, _ = entry
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for e in node.inputs:
+            visit(e)
+        nodes.append(node)
+
+    for h in heads:
+        visit(h)
+    return nodes, variables
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. all reachable marked variables.
+
+    Builds f(var_values) = concat(head values) by replaying the tape, then a
+    single jax.vjp. The replay re-executes forward inside the compiled vjp —
+    the standard functional trade (reference avoids it by storing every
+    intermediate in HBM; XLA rematerializes cheaper than it stores).
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    head_entries = []
+    for h in heads:
+        if h._ag_node is None:
+            raise MXNetError("cannot differentiate: output not recorded "
+                             "(is autograd.record() active?)")
+        head_entries.append(h._ag_node)
+
+    if head_grads is None:
+        head_grads = [jnp.ones_like(h._data) for h in heads]
+    else:
+        head_grads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in head_grads]
+
+    nodes, variables = _collect(head_entries)
+    if not variables:
+        raise MXNetError("no variables with gradients reachable from heads")
+
+    node_list = nodes
+    var_index = {id(v): i for i, v in enumerate(variables)}
+    node_index = {id(n): i for i, n in enumerate(node_list)}
+    head_specs = []
+    for e in head_entries:
+        if isinstance(e, AGVar):
+            head_specs.append(("var", var_index[id(e)]))
+        else:
+            node, idx = e
+            head_specs.append(("node", node_index[id(node)], idx))
+
+    def replay(var_values):
+        node_outs = [None] * len(node_list)
+        for ni, node in enumerate(node_list):
+            args = []
+            for e, captured in zip(node.inputs, node.input_values):
+                if isinstance(e, AGVar):
+                    args.append(var_values[var_index[id(e)]])
+                elif e is None:
+                    args.append(captured)
+                else:
+                    n2, idx2 = e
+                    args.append(node_outs[node_index[id(n2)]][idx2])
+            res = node.fn(*args)
+            if not isinstance(res, tuple):
+                res = (res,)
+            node_outs[ni] = res
+        outs = []
+        for spec in head_specs:
+            if spec[0] == "var":
+                outs.append(var_values[spec[1]])
+            else:
+                outs.append(node_outs[spec[1]][spec[2]])
+        return tuple(outs)
+
+    var_values = [v.value for v in variables]
+    _, vjp_fn = jax.vjp(lambda *vs: replay(vs), *var_values)
+    grads = vjp_fn(tuple(head_grads))
+
+    for v, g in zip(variables, grads):
+        nd = v.nd
+        req = getattr(nd, "_grad_req", "write")
+        if req == "null" or nd._grad is None:
+            continue
+        if req == "add":
+            nd._grad._data = nd._grad._data + g
+        else:
+            nd._grad._data = g
+
+    if not retain_graph:
+        for h in heads:
+            pass  # tape nodes are GC'd once outputs drop references
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: mx.autograd.grad (autograd.py:270) — returns grads instead of
+    writing .grad buffers. create_graph=True is not yet supported."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if create_graph:
+        raise MXNetError("create_graph=True not supported yet")
+
+    head_entries = [h._ag_node for h in heads]
+    for e in head_entries:
+        if e is None:
+            raise MXNetError("output not recorded")
+    nodes, all_vars = _collect(head_entries)
+    # ensure requested variables are leaves
+    want = []
+    for v in variables:
+        e = v._ag_node
+        if not isinstance(e, AGVar):
+            raise MXNetError("requested variable was not marked "
+                             "(call attach_grad() before record)")
+        want.append(e)
+
+    saved = [(v.nd, getattr(v.nd, "_grad", None), getattr(v.nd, "_grad_req", "write"))
+             for v in all_vars]
+    tmp = []
+    for v in variables:
+        from .ndarray.ndarray import zeros_like as _zl
+        g = _zl(v)
+        v._grad = g
+        v._grad_req = "write"
+        tmp.append(g)
+    backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
+    out = [v._grad for v in variables]
+    for nd, g, req in saved:
+        if nd not in variables:
+            nd._grad, nd._grad_req = g, req
+    return out
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported; use "
+                     "Gluon HybridBlock tracing instead")

@@ -1,0 +1,209 @@
+"""Base utilities: dtype maps, attribute parsing, naming, errors.
+
+TPU-native re-design of the roles of include/mxnet/base.h + python/mxnet/base.py
+and the dmlc::Parameter attribute system (reference: python/mxnet/base.py,
+src/operator param structs e.g. src/operator/rnn-inl.h:141). Instead of a C ABI
+with string-marshalled kwargs, attrs are parsed python-side into typed values
+that become static arguments of jitted XLA computations.
+"""
+from __future__ import annotations
+
+import ast
+import threading
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "string_types", "numeric_types",
+    "DTYPES", "np_dtype", "dtype_name",
+    "NameManager", "AttrScope",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# dtype registry: canonical name -> numpy dtype. bfloat16 is first-class on TPU.
+import ml_dtypes as _ml_dtypes  # ships with jax
+
+bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+
+DTYPES = {
+    "float32": _np.dtype("float32"),
+    "float64": _np.dtype("float64"),
+    "float16": _np.dtype("float16"),
+    "bfloat16": bfloat16,
+    "uint8": _np.dtype("uint8"),
+    "int8": _np.dtype("int8"),
+    "int32": _np.dtype("int32"),
+    "int64": _np.dtype("int64"),
+    "bool": _np.dtype("bool"),
+}
+_NAME_OF = {v: k for k, v in DTYPES.items()}
+
+
+def np_dtype(dtype):
+    """Coerce a user-supplied dtype (str/np.dtype/type) to a numpy dtype."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype not in DTYPES:
+            raise MXNetError(f"unknown dtype {dtype!r}")
+        return DTYPES[dtype]
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = _np.dtype(dtype) if not isinstance(dtype, _np.dtype) else dtype
+    try:
+        return _NAME_OF[d]
+    except KeyError:
+        return d.name
+
+
+# ---------------------------------------------------------------------------
+# Attribute (parameter) parsing — replaces dmlc::Parameter string marshalling.
+# ---------------------------------------------------------------------------
+
+def parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, _np.integer)):
+        return bool(v)
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("true", "1"):
+            return True
+        if s in ("false", "0"):
+            return False
+    raise MXNetError(f"cannot parse bool from {v!r}")
+
+
+def parse_int(v) -> int:
+    if isinstance(v, str):
+        return int(v.strip())
+    return int(v)
+
+
+def parse_float(v) -> float:
+    if isinstance(v, str):
+        return float(v.strip())
+    return float(v)
+
+
+def parse_shape(v):
+    """Parse a shape-like attr: (3,3), [3,3], "(3, 3)", "3", 3 -> tuple of int."""
+    if v is None:
+        return None
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    if isinstance(v, (int, _np.integer)):
+        return (int(v),)
+    if isinstance(v, str):
+        s = v.strip()
+        if s in ("None", "()"):
+            return () if s == "()" else None
+        val = ast.literal_eval(s)
+        if isinstance(val, (tuple, list)):
+            return tuple(int(x) for x in val)
+        return (int(val),)
+    raise MXNetError(f"cannot parse shape from {v!r}")
+
+
+def attr_to_string(v) -> str:
+    """Serialize an attr value the way MXNet JSON does (str() of the value)."""
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(int(x)) if isinstance(x, (int, _np.integer))
+                               else str(x) for x in v) + ")"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# Naming + attribute scopes (parity: python/mxnet/name.py, attribute.py)
+# ---------------------------------------------------------------------------
+
+class NameManager:
+    """Automatic unique naming for symbols/blocks (python/mxnet/name.py)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        self._old = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old
+
+    @classmethod
+    def current(cls) -> "NameManager":
+        v = getattr(cls._current, "value", None)
+        if v is None:
+            v = NameManager()
+            cls._current.value = v
+        return v
+
+
+class Prefix(NameManager):
+    """NameManager that adds a constant prefix to all names."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name is not None else self._prefix + super().get(None, hint)
+
+
+class AttrScope:
+    """Scope for symbol attributes, e.g. ctx_group for model parallelism
+    (reference: python/mxnet/attribute.py; used by PlaceDevice pass,
+    src/executor/graph_executor.cc:314)."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attrs = {k: str(v) for k, v in kwargs.items()}
+        self._old = None
+
+    def get(self, attrs):
+        cur = dict(self._attrs)
+        if attrs:
+            cur.update(attrs)
+        return cur
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, "value", None)
+        merged = dict(self._old._attrs) if self._old is not None else {}
+        merged.update(self._attrs)
+        self._attrs = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current.value = self._old
+
+    @classmethod
+    def current(cls) -> "AttrScope":
+        v = getattr(cls._current, "value", None)
+        if v is None:
+            v = AttrScope()
+            cls._current.value = v
+        return v

@@ -1,0 +1,120 @@
+"""Device context. TPU-native analog of python/mxnet/context.py.
+
+`Context('tpu', i)` maps onto a jax accelerator device; `Context('cpu', i)` maps
+onto the host platform. `mx.gpu(i)` is kept as a compatibility alias for the
+accelerator so reference scripts written for GPUs run unchanged on TPU
+(BASELINE.json north star: "Add a native `tpu` context alongside `gpu`").
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_devtype2mask = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+_devmask2type = {v: k for k, v in _devtype2mask.items()}
+
+
+class Context:
+    """A device context (device_type, device_id).
+
+    Unlike the reference (include/mxnet/base.h Context), this resolves to a
+    concrete `jax.Device`; computation placement is achieved by committing
+    input buffers to the device and letting XLA follow shardings.
+    """
+
+    _current = threading.local()
+    default_ctx = None
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type = device_type.device_type
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in _devtype2mask:
+                raise ValueError(f"unknown device type {device_type!r}")
+            self.device_type = device_type
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_typeid(self):
+        return _devtype2mask[self.device_type]
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazy; import-time safe)."""
+        import jax
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu")
+        else:
+            # 'tpu' and the 'gpu' compat alias both mean "the accelerator":
+            # whatever platform jax's default backend exposes.
+            devs = jax.devices()
+            if devs and devs[0].platform == "cpu":
+                # host-only environment (tests): accelerator alias -> cpu devices
+                devs = jax.devices("cpu")
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"device_id {self.device_id} out of range for {self.device_type} "
+                f"({len(devs)} devices)")
+        return devs[self.device_id]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._current, "value", None)
+        Context._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._current.value = self._old_ctx
+
+    def empty_cache(self):
+        """Parity no-op: PJRT owns the HBM pool (vs GPUPooledStorageManager,
+        src/storage/pooled_storage_manager.h:48)."""
+
+
+Context.default_ctx = Context("cpu", 0)
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: the accelerator device (TPU here)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    cur = getattr(Context._current, "value", None)
+    return cur if cur is not None else Context.default_ctx
+
+
+def num_tpus() -> int:
+    import jax
+    try:
+        devs = jax.devices()
+        return len(devs) if devs and devs[0].platform != "cpu" else 0
+    except RuntimeError:
+        return 0
+
+
+def num_gpus() -> int:
+    """Compat alias (mx.context.num_gpus): count of accelerator devices."""
+    return num_tpus()

@@ -1,0 +1,37 @@
+"""Engine facade (parity surface for include/mxnet/engine.h + mx.engine).
+
+The reference's ThreadedEngine schedules ops as dependency-tracked async
+closures over worker threads (SURVEY.md §2.1). On TPU the XLA runtime *is*
+that engine: dispatch is async, dependencies are buffer data-flow, and
+completion/error surfaces at blocking reads. This module keeps the public
+knobs (`bulk`, `set_bulk_size`, waitall) as no-op-compatible shims so
+reference scripts run; real batching is done by jit fusion.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_bulk_size = 0
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity: Engine bulk-exec hook (engine.h:287-294). XLA fuses regions
+    under jit instead; the knob is recorded but has no scheduling effect."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_all():
+    """Engine::WaitForAll — drain all outstanding async work."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()

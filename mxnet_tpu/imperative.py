@@ -1,0 +1,114 @@
+"""Imperative op invocation: the TPU-native analog of
+Imperative::Invoke → Engine::PushAsync (src/imperative/imperative.cc:86,
+include/mxnet/engine.h:183).
+
+The reference pushes every op as an async closure onto per-device worker
+threads; dependency tracking comes from engine vars. Here, *XLA's async
+dispatch is the engine*: each (op, static attrs, is_train) triple is compiled
+once to a TPU executable (cached by jax on input shapes), calls return
+immediately with futures (jax.Array), and data dependencies are tracked by the
+runtime. `NDArray.wait_to_read` == block_until_ready (engine WaitForVar,
+including deferred exception rethrow semantics — XLA surfaces async errors at
+the first blocking read, matching threaded_engine.cc:465).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .base import MXNetError
+from .ops.registry import OpCtx, OpSchema
+
+_JIT_CACHE: dict = {}
+
+
+def _num_outputs(schema: OpSchema, attrs) -> int:
+    n = schema.num_outputs
+    return n(attrs) if callable(n) else n
+
+
+def jitted_for_schema(schema: OpSchema, attrs, is_train: bool):
+    """One compiled executable per (op, attrs, is_train); jax caches on avals."""
+    key = (schema.name, attrs.frozen(), bool(is_train))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        if schema.needs_rng:
+            def raw(rng, *inputs):
+                return schema.fcompute(attrs, OpCtx(is_train=is_train, rng=rng),
+                                       *inputs)
+        else:
+            def raw(*inputs):
+                return schema.fcompute(attrs, OpCtx(is_train=is_train), *inputs)
+        fn = jax.jit(raw)
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None):
+    """Execute an op imperatively on NDArrays; records on the autograd tape."""
+    from . import autograd
+    from .ndarray.ndarray import NDArray
+    from . import random as _random
+
+    attrs = schema.parse_attrs(kwargs)
+    n_in = schema.num_inputs(attrs)
+    if len(inputs) != n_in:
+        raise MXNetError(
+            f"op {schema.name} expects {n_in} inputs, got {len(inputs)}")
+    if is_train is None:
+        is_train = autograd.is_training()
+
+    fn = jitted_for_schema(schema, attrs, is_train)
+    datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    rng = _random.next_key() if schema.needs_rng else None
+    results = fn(rng, *datas) if schema.needs_rng else fn(*datas)
+    if not isinstance(results, tuple):
+        results = (results,)
+
+    n_out = _num_outputs(schema, attrs)
+    outputs = [NDArray(r) for r in results[:n_out]]
+
+    # auxiliary-state write-back (BatchNorm moving stats): emulates the
+    # reference's in-place aux mutation by rebinding the aux NDArray's buffer
+    if schema.mutates_aux and is_train:
+        for j, aux_i in enumerate(schema.aux_indices):
+            src = inputs[aux_i]
+            if isinstance(src, NDArray):
+                src._data = results[n_out + j]
+
+    if autograd.is_recording():
+        autograd._record(schema, attrs, rng, is_train, inputs, outputs, n_out)
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, outputs):
+            dst._data = src._data
+            dst._ag_node = src._ag_node
+        return out
+    if len(outputs) == 1:
+        return outputs[0]
+    return outputs
+
+
+def apply_fn(fn, inputs, jit_key=None, num_outputs=1):
+    """Execute an ad-hoc jax-traceable fn(*arrays)->tuple on NDArrays with
+    autograd recording (used for indexing and python-side composites)."""
+    from . import autograd
+    from .ndarray.ndarray import NDArray
+
+    if jit_key is not None:
+        jfn = _JIT_CACHE.get(jit_key)
+        if jfn is None:
+            jfn = jax.jit(fn)
+            _JIT_CACHE[jit_key] = jfn
+    else:
+        jfn = jax.jit(fn)
+    datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    results = jfn(*datas)
+    if not isinstance(results, tuple):
+        results = (results,)
+    outputs = [NDArray(r) for r in results]
+    if autograd.is_recording():
+        autograd._record_fn(fn, inputs, outputs)
+    return outputs if num_outputs > 1 else outputs[0]

@@ -1,0 +1,587 @@
+"""NDArray — the imperative tensor.
+
+Parity target: include/mxnet/ndarray.h + python/mxnet/ndarray/ndarray.py
+(SURVEY.md §2.1/§2.4). The reference NDArray is a ref-counted chunk with an
+engine variable; ops are async closures and reads block on WaitToRead. Here an
+NDArray wraps a `jax.Array`: XLA async dispatch provides the same
+future-semantics (`wait_to_read` == block_until_ready; async errors surface at
+the first blocking read, matching engine WaitForVar rethrow,
+threaded_engine.cc:465). Mutation APIs (`x[...] = v`, `+=`) are emulated by
+functional `.at[].set` updates that rebind the wrapped buffer — XLA donates the
+input buffer so this compiles to an in-place update on TPU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from ..ops.registry import get_op
+from .. import imperative as _imp
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "concatenate", "save", "load",
+           "waitall", "imdecode", "moveaxis"]
+
+
+def _dev_ctx(data) -> Context:
+    try:
+        dev = list(data.devices())[0] if hasattr(data, "devices") else data.device
+    except Exception:
+        return current_context()
+    plat = getattr(dev, "platform", "cpu")
+    if plat == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+def _invoke(name, *inputs, **kwargs):
+    out = kwargs.pop("out", None)
+    return _imp.invoke(get_op(name), list(inputs), kwargs, out=out)
+
+
+class NDArray:
+    __slots__ = ("_data", "_ag_node", "_grad", "_grad_req", "__weakref__")
+
+    def __init__(self, data):
+        self._data = data
+        self._ag_node = None
+        self._grad = None
+        self._grad_req = "write"
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def context(self) -> Context:
+        return _dev_ctx(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return _invoke("transpose", self)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def __repr__(self):
+        return f"\n{_np.asarray(self._data)!s}\n<NDArray {self.shape} @{self.context}>"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element NDArray")
+        return bool(_np.asarray(self._data))
+
+    def __float__(self):
+        return float(_np.asarray(self._data))
+
+    def __int__(self):
+        return int(_np.asarray(self._data))
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- host transfer ------------------------------------------------------
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    item = asscalar
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def copy(self):
+        return _invoke("_copy", self)
+
+    def copyto(self, other):
+        import jax
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other.context.jax_device())
+            if other.dtype != self.dtype:
+                other._data = other._data.astype(other.dtype)
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self.context:
+            return self
+        import jax
+        return NDArray(jax.device_put(self._data, ctx.jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and dt == self.dtype:
+            return self
+        return _invoke("Cast", self, dtype=dt.name if dt.name in
+                       ("float32", "float64", "float16", "uint8", "int8",
+                        "int32", "int64", "bool") else str(dt))
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage emulated as dense on TPU; "
+                             "see mxnet_tpu.ndarray.sparse")
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+        self._grad = zeros_like(self)
+        self._grad_req = grad_req
+        autograd.mark_variables([self], [self._grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops ----------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        elif len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = shape[0]
+        return _invoke("Reshape", self, shape=tuple(shape),
+                       reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _invoke("Reshape", self, shape=other.shape)
+
+    def flatten(self):
+        return _invoke("Flatten", self)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", self, axes=axes or ())
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("SwapAxis", self, dim1=dim1, dim2=dim2)
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", self, axis=axis)
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return _invoke("broadcast_like", self, other)
+
+    def clip(self, a_min, a_max):
+        return _invoke("clip", self, a_min=a_min, a_max=a_max)
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke("SliceChannel", self, num_outputs=num_outputs,
+                       axis=axis, squeeze_axis=squeeze_axis)
+
+    def tile(self, reps):
+        return _invoke("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", self, repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return _invoke("reverse", self, axis=axis)
+
+    def diag(self, k=0):
+        return _invoke("diag", self, k=k)
+
+    def one_hot(self, depth, **kw):
+        return _invoke("one_hot", self, depth=depth, **kw)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", self, indices, axis=axis, mode=mode)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _invoke("pick", self, index, axis=axis, keepdims=keepdims)
+
+    # -- reductions ---------------------------------------------------------
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke("mean", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return _invoke("prod", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return _invoke("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return _invoke("min", self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", self, axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", self, axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", self, axis=axis, k=k, ret_typ=ret_typ,
+                       is_ascend=is_ascend)
+
+    def abs(self):
+        return _invoke("abs", self)
+
+    def sqrt(self):
+        return _invoke("sqrt", self)
+
+    def square(self):
+        return _invoke("square", self)
+
+    def exp(self):
+        return _invoke("exp", self)
+
+    def log(self):
+        return _invoke("log", self)
+
+    def sigmoid(self):
+        return _invoke("sigmoid", self)
+
+    def tanh(self):
+        return _invoke("tanh", self)
+
+    def relu(self):
+        return _invoke("relu", self)
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", self, axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke("dot", self, other, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+
+    def round(self):
+        return _invoke("round", self)
+
+    def floor(self):
+        return _invoke("floor", self)
+
+    def ceil(self):
+        return _invoke("ceil", self)
+
+    def sign(self):
+        return _invoke("sign", self)
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binary(self, other, op, scalar_op, rscalar_op=None, reverse=False):
+        if isinstance(other, NDArray):
+            if reverse:
+                return _invoke(op, other, self)
+            return _invoke(op, self, other)
+        if isinstance(other, (int, float, bool, _np.generic)):
+            name = (rscalar_op or scalar_op) if reverse else scalar_op
+            return _invoke(name, self, scalar=float(other))
+        if isinstance(other, _np.ndarray):
+            return self._binary(array(other, ctx=self.context), op, scalar_op,
+                                rscalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar",
+                            "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar",
+                            "_rdiv_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar",
+                            "_rmod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar",
+                            "_rpower_scalar", reverse=True)
+
+    def __neg__(self):
+        return _invoke("negative", self)
+
+    def __abs__(self):
+        return _invoke("abs", self)
+
+    def __eq__(self, o):
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal",
+                            "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal",
+                            "_lesser_equal_scalar")
+
+    def _inplace(self, other, op, scalar_op):
+        res = self._binary(other, op, scalar_op)
+        self._data = res._data
+        self._ag_node = res._ag_node
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, "broadcast_add", "_plus_scalar")
+
+    def __isub__(self, o):
+        return self._inplace(o, "broadcast_sub", "_minus_scalar")
+
+    def __imul__(self, o):
+        return self._inplace(o, "broadcast_mul", "_mul_scalar")
+
+    def __itruediv__(self, o):
+        return self._inplace(o, "broadcast_div", "_div_scalar")
+
+    # -- indexing -----------------------------------------------------------
+    @staticmethod
+    def _norm_key(key):
+        if isinstance(key, NDArray):
+            return key
+        if isinstance(key, tuple):
+            return tuple(NDArray._norm_key(k) for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._norm_key(key)
+        if isinstance(key, NDArray):
+            return _invoke("take", self, key, axis=0, mode="clip")
+        if isinstance(key, (list, _np.ndarray)):
+            return _invoke("take", self, array(key, ctx=self.context),
+                           axis=0, mode="clip")
+
+        def static_key_hash(k):
+            if isinstance(k, slice):
+                return ("s", k.start, k.stop, k.step)
+            if isinstance(k, tuple):
+                return tuple(static_key_hash(x) for x in k)
+            return k
+
+        jit_key = ("getitem", self.shape, str(self.dtype), static_key_hash(key))
+        return _imp.apply_fn(lambda d: (d[key],), [self], jit_key=jit_key)
+
+    def __setitem__(self, key, value):
+        key = self._norm_key(key)
+        if isinstance(key, NDArray):
+            key = key.asnumpy().astype(_np.int64)
+        if isinstance(value, NDArray):
+            v = value._data.astype(self.dtype)
+        elif isinstance(value, (int, float, bool)):
+            v = _np.asarray(value, dtype=self.dtype)[()]
+        else:
+            v = _np.asarray(value).astype(self.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            import jax.numpy as jnp
+            self._data = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype),
+                                          self.shape)
+        else:
+            self._data = self._data.at[key].set(v)
+
+
+# ---------------------------------------------------------------------------
+# factory functions (python/mxnet/ndarray/ndarray.py + utils)
+# ---------------------------------------------------------------------------
+
+def _place(data, ctx):
+    import jax
+    ctx = ctx or current_context()
+    return jax.device_put(data, ctx.jax_device())
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        arr = source_array.asnumpy()
+    elif isinstance(source_array, _np.ndarray):
+        arr = source_array
+    else:
+        # python lists/scalars default to float32 (MXNet mx_real_t semantics)
+        arr = _np.asarray(source_array)
+        if dtype is None and arr.dtype not in (_np.dtype("bool"),):
+            arr = arr.astype(_np.float32)
+    if dtype is not None:
+        arr = arr.astype(np_dtype(dtype))
+    elif arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)  # MXNet default_dtype is float32
+    return NDArray(_place(arr, ctx))
+
+
+def zeros(shape, ctx=None, dtype=None, **kw):
+    import jax.numpy as jnp
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.zeros(shape, dtype=np_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype=None, **kw):
+    import jax.numpy as jnp
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.ones(shape, dtype=np_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype=None, **kw):
+    import jax.numpy as jnp
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.full(shape, val, dtype=np_dtype(dtype)), ctx))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax.numpy as jnp
+    if stop is None:
+        start, stop = 0, start
+    a = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(_place(a, ctx))
+
+
+def zeros_like(x):
+    return _invoke("zeros_like", x)
+
+
+def ones_like(x):
+    return _invoke("ones_like", x)
+
+
+def moveaxis(x, source, destination):
+    axes = list(range(x.ndim))
+    axes.remove(source % x.ndim)
+    axes.insert(destination % x.ndim, source % x.ndim)
+    return x.transpose(axes)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke("Concat", *arrays, num_args=len(arrays), dim=axis)
+
+
+def waitall():
+    """Parity: mx.nd.waitall == Engine::WaitForAll."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def imdecode(*a, **kw):
+    raise MXNetError("imdecode: use mxnet_tpu.image")
+
+
+# -- serialization (role of NDArray::Save/Load, src/ndarray/ndarray.cc:1582;
+#    container format replaced by npz — TPU build has no C ABI consumers) ----
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        _np.savez(fname, __order__=_np.array([], dtype=_np.str_),
+                  **{"__single__": data.asnumpy()})
+    elif isinstance(data, (list, tuple)):
+        _np.savez(fname, __order__=_np.array([], dtype=_np.str_),
+                  **{f"__list__{i}": d.asnumpy() for i, d in enumerate(data)})
+    elif isinstance(data, dict):
+        _np.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
+    else:
+        raise TypeError("save: data must be NDArray, list, or dict")
+    import os
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != "__order__"]
+        if keys == ["__single__"]:
+            return [array(z["__single__"])]
+        if all(k.startswith("__list__") for k in keys):
+            keys.sort(key=lambda k: int(k[8:]))
+            return [array(z[k]) for k in keys]
+        return {k: array(z[k]) for k in keys}

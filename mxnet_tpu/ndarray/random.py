@@ -1,0 +1,68 @@
+"""mx.nd.random namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op
+from .. import imperative as _imp
+
+
+def _invoke(name, inputs, kwargs):
+    out = kwargs.pop("out", None)
+    kwargs.pop("ctx", None)
+    return _imp.invoke(get_op(name), inputs, kwargs, out=out)
+
+
+def _two_form(sampler_name, sample_name, p1, p2):
+    def fn(a=0.0, b=1.0, shape=(1,), dtype="float32", ctx=None, out=None, **kw):
+        from .ndarray import NDArray
+        if isinstance(a, NDArray) or isinstance(b, NDArray):
+            return _invoke(sample_name, [a, b],
+                           {"shape": None if shape == (1,) else shape,
+                            "dtype": dtype, "out": out})
+        return _invoke(sampler_name, [],
+                       {p1: a, p2: b, "shape": shape, "dtype": dtype,
+                        "out": out})
+    return fn
+
+
+uniform = _two_form("_random_uniform", "_sample_uniform", "low", "high")
+normal = _two_form("_random_normal", "_sample_normal", "loc", "scale")
+gamma = _two_form("_random_gamma", "_sample_gamma", "alpha", "beta")
+
+
+def exponential(scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None, **kw):
+    return _invoke("_random_exponential", [],
+                   {"lam": 1.0 / scale, "shape": shape, "dtype": dtype,
+                    "out": out})
+
+
+def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None, **kw):
+    return _invoke("_random_poisson", [],
+                   {"lam": lam, "shape": shape, "dtype": dtype, "out": out})
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None,
+                      out=None, **kw):
+    return _invoke("_random_negative_binomial", [],
+                   {"k": k, "p": p, "shape": shape, "dtype": dtype, "out": out})
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                  dtype="float32", ctx=None, out=None, **kw):
+    return _invoke("_random_generalized_negative_binomial", [],
+                   {"mu": mu, "alpha": alpha, "shape": shape, "dtype": dtype,
+                    "out": out})
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None, **kw):
+    return _invoke("_random_randint", [],
+                   {"low": low, "high": high, "shape": shape, "dtype": dtype,
+                    "out": out})
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _invoke("_sample_multinomial", [data],
+                   {"shape": shape, "get_prob": get_prob, "dtype": dtype})
+
+
+def shuffle(data, **kw):
+    return _invoke("_shuffle", [data], {})

@@ -1,0 +1,131 @@
+"""Sparse NDArray API (parity surface for python/mxnet/ndarray/sparse.py).
+
+TPU-honest design (SURVEY.md §7 stage 11): TPU/XLA has no efficient sparse
+storage, so `row_sparse` and `csr` are *dense-backed views with sparse
+metadata*. The API (indices/indptr/data accessors, tostype, retain) is
+preserved so kvstore row_sparse paths and tests run; compute falls back to
+dense XLA ops, which on TPU is usually faster than emulated gather/scatter
+for the reference's workloads anyway.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array, zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        import numpy as np
+        a = self.asnumpy()
+        idx = [np.nonzero(row)[0] for row in a]
+        return array(np.concatenate(idx) if idx else np.array([]),
+                     dtype="int64")
+
+    @property
+    def indptr(self):
+        import numpy as np
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        return array(np.concatenate([[0], np.cumsum(counts)]), dtype="int64")
+
+    @property
+    def data(self):
+        import numpy as np
+        a = self.asnumpy()
+        return array(a[a != 0])
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        import numpy as np
+        a = self.asnumpy().reshape(self.shape[0], -1)
+        nz = np.nonzero((a != 0).any(axis=1))[0]
+        return array(nz, dtype="int64")
+
+    @property
+    def data(self):
+        import numpy as np
+        a = self.asnumpy()
+        nz = _np.nonzero((a.reshape(a.shape[0], -1) != 0).any(axis=1))[0]
+        return array(a[nz])
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
+        indices = _np.asarray(getattr(indices, "asnumpy", lambda: indices)(),
+                              dtype=_np.int64)
+        indptr = _np.asarray(getattr(indptr, "asnumpy", lambda: indptr)(),
+                             dtype=_np.int64)
+        dense = _np.zeros(shape, dtype=data.dtype if dtype is None else dtype)
+        for r in range(shape[0]):
+            for j in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[j]] = data[j]
+        nd = array(dense, ctx=ctx, dtype=dtype)
+    else:
+        nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
+                   dtype=dtype)
+    out = CSRNDArray(nd._data)
+    return out
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
+        indices = _np.asarray(getattr(indices, "asnumpy", lambda: indices)(),
+                              dtype=_np.int64)
+        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
+        dense = _np.zeros(full_shape,
+                          dtype=data.dtype if dtype is None else dtype)
+        dense[indices] = data
+        nd = array(dense, ctx=ctx, dtype=dtype)
+    else:
+        nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
+                   dtype=dtype)
+    return RowSparseNDArray(nd._data)
+
+
+def zeros_sparse(stype, shape, ctx=None, dtype=None):
+    nd = zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        return CSRNDArray(nd._data)
+    if stype == "row_sparse":
+        return RowSparseNDArray(nd._data)
+    return nd

@@ -1,0 +1,160 @@
+"""Random sampling operators (parity: src/operator/random/, SURVEY.md §2.2).
+
+The reference uses per-device curand/mt19937 resources; here each sampler is a
+pure function of an explicit jax PRNG key supplied by the global key chain
+(mxnet_tpu.random), so results are reproducible under mx.random.seed while
+every invocation stays a compiled pure computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Param, register
+
+
+def _t(*o):
+    return tuple(o)
+
+
+def _dt(attrs):
+    from ..base import np_dtype
+    return np_dtype(attrs.get("dtype") or "float32")
+
+
+_SHAPE_PARAMS = {"shape": Param("shape", (1,)),
+                 "dtype": Param("dtype", "float32"),
+                 "ctx": Param("str", None)}
+
+
+def _reg_random(name, fn, extra):
+    params = dict(_SHAPE_PARAMS)
+    params.update(extra)
+
+    def fcompute(attrs, octx, *_):
+        return _t(fn(octx.rng, attrs).astype(_dt(attrs)))
+
+    register(name, fcompute, params=params, inputs=(), needs_rng=True)
+
+
+_reg_random("_random_uniform",
+            lambda k, a: jax.random.uniform(k, a["shape"], minval=a["low"],
+                                            maxval=a["high"]),
+            {"low": Param("float", 0.0), "high": Param("float", 1.0)})
+_reg_random("_random_normal",
+            lambda k, a: a["loc"] + a["scale"] * jax.random.normal(k, a["shape"]),
+            {"loc": Param("float", 0.0), "scale": Param("float", 1.0)})
+_reg_random("_random_gamma",
+            lambda k, a: jax.random.gamma(k, a["alpha"], a["shape"]) * a["beta"],
+            {"alpha": Param("float", 1.0), "beta": Param("float", 1.0)})
+_reg_random("_random_exponential",
+            lambda k, a: jax.random.exponential(k, a["shape"]) / a["lam"],
+            {"lam": Param("float", 1.0)})
+_reg_random("_random_poisson",
+            lambda k, a: jax.random.poisson(k, a["lam"], a["shape"]).astype(
+                jnp.float32),
+            {"lam": Param("float", 1.0)})
+_reg_random("_random_negative_binomial",
+            lambda k, a: _neg_binomial(k, a["k"], a["p"], a["shape"]),
+            {"k": Param("int", 1), "p": Param("float", 1.0)})
+_reg_random("_random_generalized_negative_binomial",
+            lambda k, a: _gen_neg_binomial(k, a["mu"], a["alpha"], a["shape"]),
+            {"mu": Param("float", 1.0), "alpha": Param("float", 1.0)})
+_reg_random("_random_randint",
+            lambda k, a: jax.random.randint(k, a["shape"], int(a["low"]),
+                                            int(a["high"])),
+            {"low": Param("float", 0.0), "high": Param("float", 1.0)})
+
+
+def _neg_binomial(key, r, p, shape):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
+
+
+def _gen_neg_binomial(key, mu, alpha, shape):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
+
+
+# sample_* family: distribution params given as arrays; one sample (or `shape`
+# samples) drawn per parameter element.
+
+def _reg_sample(name, fn, n_params):
+    def fcompute(attrs, octx, *inputs):
+        extra = attrs["shape"] or ()
+        out = fn(octx.rng, *inputs, extra)
+        return _t(out)
+
+    inputs = ("low", "high")[:n_params] if "uniform" in name else \
+        tuple(f"p{i}" for i in range(n_params))
+    register(name, fcompute,
+             params={"shape": Param("shape", None),
+                     "dtype": Param("dtype", "float32")},
+             inputs=inputs, needs_rng=True)
+
+
+def _samp_shape(param, extra):
+    return tuple(param.shape) + tuple(extra)
+
+
+def _bcast(p, extra):
+    return p.reshape(p.shape + (1,) * len(tuple(extra)))
+
+
+_reg_sample("_sample_uniform",
+            lambda k, lo, hi, e: jax.random.uniform(
+                k, _samp_shape(lo, e)) * (_bcast(hi - lo, e)) + _bcast(lo, e),
+            2)
+_reg_sample("_sample_normal",
+            lambda k, mu, sig, e: _bcast(mu, e) + _bcast(sig, e) *
+            jax.random.normal(k, _samp_shape(mu, e)), 2)
+_reg_sample("_sample_gamma",
+            lambda k, a, b, e: jax.random.gamma(
+                k, _bcast(a, e), _samp_shape(a, e)) * _bcast(b, e), 2)
+_reg_sample("_sample_exponential",
+            lambda k, lam, e: jax.random.exponential(
+                k, _samp_shape(lam, e)) / _bcast(lam, e), 1)
+_reg_sample("_sample_poisson",
+            lambda k, lam, e: jax.random.poisson(
+                k, _bcast(lam, e), _samp_shape(lam, e)).astype(jnp.float32), 1)
+
+
+def _sample_multinomial(attrs, octx, data):
+    shape = attrs["shape"] or ()
+    n = 1
+    for s in shape:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        draws = jax.random.categorical(octx.rng, logits, shape=(n,))
+        out = draws.reshape(shape) if shape else draws[0]
+    else:
+        draws = jax.random.categorical(octx.rng, logits[:, None, :],
+                                       axis=-1, shape=(data.shape[0], n))
+        out = draws.reshape((data.shape[0],) + tuple(shape)) if shape \
+            else draws[:, 0]
+    outs = [out.astype(_dt(attrs))]
+    if attrs["get_prob"]:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1).reshape(-1, data.shape[-1]),
+            out.reshape(-1, 1).astype(jnp.int32), axis=1)
+        outs.append(lp.reshape(out.shape))
+    return tuple(outs)
+
+
+_mult_schema = register("_sample_multinomial", _sample_multinomial,
+                        params={"shape": Param("shape", None),
+                                "get_prob": Param("bool", False),
+                                "dtype": Param("dtype", "int32")},
+                        inputs=("data",), needs_rng=True)
+_mult_schema.num_outputs = lambda a: 2 if a["get_prob"] else 1  # type: ignore
+
+
+def _shuffle(attrs, octx, data):
+    return _t(jax.random.permutation(octx.rng, data, axis=0))
+
+register("_shuffle", _shuffle, needs_rng=True, aliases=("shuffle",))

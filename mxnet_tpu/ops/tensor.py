@@ -1,0 +1,1037 @@
+"""Tensor operators: elementwise / broadcast / scalar / reduce / matrix /
+indexing / init / ordering families.
+
+Parity target: src/operator/tensor/ (SURVEY.md §2.2 — elemwise_unary_op*,
+elemwise_binary_op*, broadcast_reduce-inl, matrix_op, indexing_op.h, dot-inl.h,
+init_op, ordering_op, la_op). Every op is a pure jax function registered in the
+op registry; XLA fuses elementwise chains into surrounding matmuls so the
+mshadow kernel-per-op model is unnecessary on TPU.
+
+Semantics notes (MXNet parity):
+  - comparison ops return the *input* dtype (1.0/0.0), not bool
+  - argmax/argmin/topk indices are float32 by default
+  - Reshape supports the 0/-1/-2/-3/-4 special codes
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import Param, register
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _t(*outs):
+    return tuple(outs)
+
+
+def _same_shape_infer(n_in):
+    """Bidirectional same-shape inference for elementwise ops."""
+    def infer(attrs, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        if known is None:
+            return in_shapes, [None]
+        filled = [known if s is None else s for s in in_shapes]
+        for s in filled:
+            if tuple(s) != tuple(known):
+                # let broadcast ops through; same-shape family must match
+                pass
+        return filled, [known]
+    return infer
+
+
+def _unary(name, fn, aliases=(), float_out=False):
+    def fcompute(attrs, octx, x):
+        y = fn(x)
+        return _t(y)
+    register(name, fcompute, inputs=("data",), aliases=aliases,
+             infer_shape=_same_shape_infer(1))
+
+
+def _binary_broadcast(name, fn, aliases=(), cast_to_input=False):
+    def fcompute(attrs, octx, lhs, rhs):
+        y = fn(lhs, rhs)
+        if cast_to_input:
+            y = y.astype(lhs.dtype)
+        return _t(y)
+    register(name, fcompute, inputs=("lhs", "rhs"), aliases=aliases)
+
+
+def _binary_elemwise(name, fn, aliases=(), cast_to_input=False):
+    def fcompute(attrs, octx, lhs, rhs):
+        y = fn(lhs, rhs)
+        if cast_to_input:
+            y = y.astype(lhs.dtype)
+        return _t(y)
+    register(name, fcompute, inputs=("lhs", "rhs"), aliases=aliases,
+             infer_shape=_same_shape_infer(2))
+
+
+def _scalar_op(name, fn, aliases=(), cast_to_input=False):
+    def fcompute(attrs, octx, x):
+        s = attrs["scalar"]
+        y = fn(x, jnp.asarray(s, dtype=x.dtype) if not isinstance(s, bool) else s)
+        if cast_to_input:
+            y = y.astype(x.dtype)
+        return _t(y)
+    register(name, fcompute, params={"scalar": Param("float", 0.0, True)},
+             inputs=("data",), aliases=aliases, infer_shape=_same_shape_infer(1))
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (src/operator/tensor/elemwise_unary_op_basic.cc etc.)
+# ---------------------------------------------------------------------------
+
+_unary("relu", lambda x: jnp.maximum(x, 0), aliases=("_relu",))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", lambda x: x / (1 + jnp.abs(x)))
+_unary("tanh", jnp.tanh)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("square", jnp.square)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("negative", jnp.negative, aliases=("_np_negative",))
+_unary("reciprocal", jnp.reciprocal)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+
+
+def _identity(attrs, octx, x):
+    return _t(x)
+
+register("_copy", _identity, aliases=("identity",),
+         infer_shape=_same_shape_infer(1))
+
+
+def _blockgrad(attrs, octx, x):
+    return _t(jax.lax.stop_gradient(x))
+
+register("BlockGrad", _blockgrad, aliases=("stop_gradient",),
+         infer_shape=_same_shape_infer(1))
+
+
+def _make_loss_t(attrs, octx, x):
+    # tensor-level make_loss: identity fwd, grad == 1 (src/operator/tensor/
+    # elemwise_unary_op_basic.cc make_loss). Implemented via custom_vjp.
+    return _t(_make_loss_fn(x))
+
+@jax.custom_vjp
+def _make_loss_fn(x):
+    return x
+
+def _ml_fwd(x):
+    return x, None
+
+def _ml_bwd(res, g):
+    return (jnp.ones_like(g),)
+
+_make_loss_fn.defvjp(_ml_fwd, _ml_bwd)
+register("make_loss", _make_loss_t, infer_shape=_same_shape_infer(1))
+
+
+def _cast(attrs, octx, x):
+    from ..base import np_dtype
+    return _t(x.astype(np_dtype(attrs["dtype"])))
+
+register("Cast", _cast, params={"dtype": Param("dtype", "float32", True)},
+         aliases=("cast",), infer_shape=_same_shape_infer(1))
+
+
+def _smooth_l1(attrs, octx, x):
+    s2 = attrs["scalar"] ** 2
+    ax = jnp.abs(x)
+    return _t(jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2))
+
+register("smooth_l1", _smooth_l1, params={"scalar": Param("float", 1.0)},
+         infer_shape=_same_shape_infer(1))
+
+# ---------------------------------------------------------------------------
+# elementwise binary + broadcast families
+# ---------------------------------------------------------------------------
+
+_binary_elemwise("elemwise_add", jnp.add, aliases=("_plus", "_Plus"))
+_binary_elemwise("elemwise_sub", jnp.subtract, aliases=("_minus", "_Minus"))
+_binary_elemwise("elemwise_mul", jnp.multiply, aliases=("_mul", "_Mul"))
+_binary_elemwise("elemwise_div", jnp.divide, aliases=("_div", "_Div"))
+_binary_elemwise("_grad_add", jnp.add)
+
+_binary_broadcast("broadcast_add", jnp.add, aliases=("broadcast_plus",))
+_binary_broadcast("broadcast_sub", jnp.subtract, aliases=("broadcast_minus",))
+_binary_broadcast("broadcast_mul", jnp.multiply)
+_binary_broadcast("broadcast_div", jnp.divide)
+_binary_broadcast("broadcast_mod", jnp.mod)
+_binary_broadcast("broadcast_power", jnp.power, aliases=("_power", "_Power"))
+_binary_broadcast("broadcast_maximum", jnp.maximum, aliases=("_maximum",))
+_binary_broadcast("broadcast_minimum", jnp.minimum, aliases=("_minimum",))
+_binary_broadcast("broadcast_hypot", jnp.hypot, aliases=("_hypot",))
+_binary_broadcast("broadcast_equal", jnp.equal, cast_to_input=True,
+                  aliases=("_equal", "_Equal"))
+_binary_broadcast("broadcast_not_equal", jnp.not_equal, cast_to_input=True,
+                  aliases=("_not_equal", "_Not_Equal"))
+_binary_broadcast("broadcast_greater", jnp.greater, cast_to_input=True,
+                  aliases=("_greater", "_Greater"))
+_binary_broadcast("broadcast_greater_equal", jnp.greater_equal,
+                  cast_to_input=True, aliases=("_greater_equal",))
+_binary_broadcast("broadcast_lesser", jnp.less, cast_to_input=True,
+                  aliases=("_lesser", "_Lesser"))
+_binary_broadcast("broadcast_lesser_equal", jnp.less_equal,
+                  cast_to_input=True, aliases=("_lesser_equal",))
+_binary_broadcast("broadcast_logical_and",
+                  lambda a, b: jnp.logical_and(a != 0, b != 0),
+                  cast_to_input=True, aliases=("_logical_and",))
+_binary_broadcast("broadcast_logical_or",
+                  lambda a, b: jnp.logical_or(a != 0, b != 0),
+                  cast_to_input=True, aliases=("_logical_or",))
+_binary_broadcast("broadcast_logical_xor",
+                  lambda a, b: jnp.logical_xor(a != 0, b != 0),
+                  cast_to_input=True, aliases=("_logical_xor",))
+
+_scalar_op("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", jnp.subtract, aliases=("_MinusScalar",))
+_scalar_op("_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",))
+_scalar_op("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", jnp.divide, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_scalar_op("_mod_scalar", jnp.mod, aliases=("_ModScalar",))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x), aliases=("_RModScalar",))
+_scalar_op("_power_scalar", jnp.power, aliases=("_PowerScalar",))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x),
+           aliases=("_RPowerScalar",))
+_scalar_op("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_scalar_op("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+_scalar_op("_hypot_scalar", jnp.hypot, aliases=("_HypotScalar",))
+_scalar_op("_equal_scalar", jnp.equal, cast_to_input=True,
+           aliases=("_EqualScalar",))
+_scalar_op("_not_equal_scalar", jnp.not_equal, cast_to_input=True,
+           aliases=("_NotEqualScalar",))
+_scalar_op("_greater_scalar", jnp.greater, cast_to_input=True,
+           aliases=("_GreaterScalar",))
+_scalar_op("_greater_equal_scalar", jnp.greater_equal, cast_to_input=True,
+           aliases=("_GreaterEqualScalar",))
+_scalar_op("_lesser_scalar", jnp.less, cast_to_input=True,
+           aliases=("_LesserScalar",))
+_scalar_op("_lesser_equal_scalar", jnp.less_equal, cast_to_input=True,
+           aliases=("_LesserEqualScalar",))
+_scalar_op("_logical_and_scalar",
+           lambda x, s: jnp.logical_and(x != 0, s != 0), cast_to_input=True)
+_scalar_op("_logical_or_scalar",
+           lambda x, s: jnp.logical_or(x != 0, s != 0), cast_to_input=True)
+_scalar_op("_logical_xor_scalar",
+           lambda x, s: jnp.logical_xor(x != 0, s != 0), cast_to_input=True)
+
+
+def _add_n(attrs, octx, *inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return _t(out)
+
+register("add_n", _add_n, params={"num_args": Param("int", None, True)},
+         inputs=("args",), key_var_num_args="num_args",
+         aliases=("ElementWiseSum", "_sum"))
+
+# ---------------------------------------------------------------------------
+# reductions (src/operator/tensor/broadcast_reduce_op*)
+# ---------------------------------------------------------------------------
+
+def _norm_axes(axis, ndim, exclude=False):
+    if axis is None:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce_op(name, fn, aliases=()):
+    def fcompute(attrs, octx, x):
+        axes = _norm_axes(attrs["axis"], x.ndim, attrs["exclude"])
+        y = fn(x, axis=axes, keepdims=attrs["keepdims"])
+        return _t(y)
+    register(name, fcompute,
+             params={"axis": Param("shape", None),
+                     "keepdims": Param("bool", False),
+                     "exclude": Param("bool", False)},
+             aliases=aliases)
+
+
+_reduce_op("sum", jnp.sum, aliases=("sum_axis",))
+_reduce_op("mean", jnp.mean)
+_reduce_op("prod", jnp.prod)
+_reduce_op("nansum", jnp.nansum)
+_reduce_op("nanprod", jnp.nanprod)
+_reduce_op("max", jnp.max, aliases=("max_axis",))
+_reduce_op("min", jnp.min, aliases=("min_axis",))
+
+
+def _argmax(attrs, octx, x):
+    ax = attrs["axis"]
+    y = jnp.argmax(x, axis=ax)
+    if attrs["keepdims"] and ax is not None:
+        y = jnp.expand_dims(y, ax)
+    return _t(y.astype(jnp.float32))
+
+def _argmin(attrs, octx, x):
+    ax = attrs["axis"]
+    y = jnp.argmin(x, axis=ax)
+    if attrs["keepdims"] and ax is not None:
+        y = jnp.expand_dims(y, ax)
+    return _t(y.astype(jnp.float32))
+
+register("argmax", _argmax, params={"axis": Param("int", None),
+                                    "keepdims": Param("bool", False)})
+register("argmin", _argmin, params={"axis": Param("int", None),
+                                    "keepdims": Param("bool", False)})
+
+
+def _argmax_channel(attrs, octx, x):
+    return _t(jnp.argmax(x, axis=1).astype(jnp.float32))
+
+register("argmax_channel", _argmax_channel)
+
+
+def _norm(attrs, octx, x):
+    ord_ = attrs["ord"]
+    axis = attrs["axis"]
+    axes = None if axis is None else _norm_axes(axis, x.ndim)
+    if ord_ == 1:
+        y = jnp.sum(jnp.abs(x), axis=axes, keepdims=attrs["keepdims"])
+    else:
+        y = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes,
+                             keepdims=attrs["keepdims"]))
+    return _t(y)
+
+register("norm", _norm, params={"ord": Param("int", 2),
+                                "axis": Param("shape", None),
+                                "keepdims": Param("bool", False)})
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot / linalg (dot-inl.h, la_op)
+# ---------------------------------------------------------------------------
+
+def _dot(attrs, octx, lhs, rhs):
+    a = lhs.T if attrs["transpose_a"] else lhs
+    b = rhs.T if attrs["transpose_b"] else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return _t(jnp.dot(a, b).reshape(1))
+    # MXNet dot: contract last axis of a with first axis of b (tensordot)
+    return _t(jnp.tensordot(a, b, axes=([a.ndim - 1], [0])))
+
+register("dot", _dot, params={"transpose_a": Param("bool", False),
+                              "transpose_b": Param("bool", False)},
+         inputs=("lhs", "rhs"))
+
+
+def _batch_dot(attrs, octx, lhs, rhs):
+    a = jnp.swapaxes(lhs, -1, -2) if attrs["transpose_a"] else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if attrs["transpose_b"] else rhs
+    return _t(jnp.matmul(a, b))
+
+register("batch_dot", _batch_dot,
+         params={"transpose_a": Param("bool", False),
+                 "transpose_b": Param("bool", False)},
+         inputs=("lhs", "rhs"))
+
+
+def _linalg_gemm2(attrs, octx, a, b):
+    x = jnp.swapaxes(a, -1, -2) if attrs["transpose_a"] else a
+    y = jnp.swapaxes(b, -1, -2) if attrs["transpose_b"] else b
+    return _t(attrs["alpha"] * jnp.matmul(x, y))
+
+register("_linalg_gemm2", _linalg_gemm2,
+         params={"transpose_a": Param("bool", False),
+                 "transpose_b": Param("bool", False),
+                 "alpha": Param("float", 1.0)},
+         inputs=("A", "B"), aliases=("linalg_gemm2",))
+
+
+def _linalg_gemm(attrs, octx, a, b, c):
+    x = jnp.swapaxes(a, -1, -2) if attrs["transpose_a"] else a
+    y = jnp.swapaxes(b, -1, -2) if attrs["transpose_b"] else b
+    return _t(attrs["alpha"] * jnp.matmul(x, y) + attrs["beta"] * c)
+
+register("_linalg_gemm", _linalg_gemm,
+         params={"transpose_a": Param("bool", False),
+                 "transpose_b": Param("bool", False),
+                 "alpha": Param("float", 1.0), "beta": Param("float", 1.0)},
+         inputs=("A", "B", "C"), aliases=("linalg_gemm",))
+
+
+def _linalg_potrf(attrs, octx, a):
+    return _t(jnp.linalg.cholesky(a))
+
+register("_linalg_potrf", _linalg_potrf, inputs=("A",),
+         aliases=("linalg_potrf",))
+
+
+def _linalg_potri(attrs, octx, a):
+    # inverse from Cholesky factor: A = L L^T input is L; potri returns A^-1
+    li = jnp.linalg.inv(a)
+    return _t(jnp.matmul(jnp.swapaxes(li, -1, -2), li))
+
+register("_linalg_potri", _linalg_potri, inputs=("A",),
+         aliases=("linalg_potri",))
+
+
+def _linalg_trsm(attrs, octx, a, b):
+    import jax.scipy.linalg as jsl
+    alpha = attrs["alpha"]
+    lower = not attrs["transpose"]
+    if attrs["rightside"]:
+        xt = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                  jnp.swapaxes(b, -1, -2),
+                                  lower=not lower, trans=0)
+        return _t(alpha * jnp.swapaxes(xt, -1, -2))
+    return _t(alpha * jsl.solve_triangular(a, b, lower=True,
+                                           trans=1 if attrs["transpose"] else 0))
+
+register("_linalg_trsm", _linalg_trsm,
+         params={"transpose": Param("bool", False),
+                 "rightside": Param("bool", False),
+                 "alpha": Param("float", 1.0)},
+         inputs=("A", "B"), aliases=("linalg_trsm",))
+
+
+def _linalg_trmm(attrs, octx, a, b):
+    at = jnp.swapaxes(a, -1, -2) if attrs["transpose"] else a
+    if attrs["rightside"]:
+        return _t(attrs["alpha"] * jnp.matmul(b, at))
+    return _t(attrs["alpha"] * jnp.matmul(at, b))
+
+register("_linalg_trmm", _linalg_trmm,
+         params={"transpose": Param("bool", False),
+                 "rightside": Param("bool", False),
+                 "alpha": Param("float", 1.0)},
+         inputs=("A", "B"), aliases=("linalg_trmm",))
+
+
+def _linalg_sumlogdiag(attrs, octx, a):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return _t(jnp.sum(jnp.log(d), axis=-1))
+
+register("_linalg_sumlogdiag", _linalg_sumlogdiag, inputs=("A",),
+         aliases=("linalg_sumlogdiag",))
+
+
+def _linalg_syrk(attrs, octx, a):
+    at = jnp.swapaxes(a, -1, -2)
+    if attrs["transpose"]:
+        return _t(attrs["alpha"] * jnp.matmul(at, a))
+    return _t(attrs["alpha"] * jnp.matmul(a, at))
+
+register("_linalg_syrk", _linalg_syrk,
+         params={"transpose": Param("bool", False),
+                 "alpha": Param("float", 1.0)},
+         inputs=("A",), aliases=("linalg_syrk",))
+
+# ---------------------------------------------------------------------------
+# shape manipulation (matrix_op)
+# ---------------------------------------------------------------------------
+
+def _reshape_infer_target(shape_attr, in_shape):
+    """Implement MXNet Reshape special codes 0,-1,-2,-3,-4
+    (src/operator/tensor/matrix_op-inl.h ReshapeParam)."""
+    out = []
+    src = list(in_shape)
+    i = 0  # index into src
+    k = 0
+    spec = list(shape_attr)
+    while k < len(spec):
+        d = spec[k]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            d1, d2 = spec[k + 1], spec[k + 2]
+            cur = src[i]; i += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); k += 2
+        else:
+            out.append(d); i += 1
+        k += 1
+    # resolve a single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in in_shape:
+            total *= d
+        out[out.index(-1)] = total // known if known else 0
+    return tuple(out)
+
+
+def _reshape(attrs, octx, x):
+    tgt = attrs["shape"]
+    if attrs["reverse"]:
+        rt = _reshape_infer_target(tuple(reversed(tgt)),
+                                   tuple(reversed(x.shape)))
+        return _t(jnp.reshape(x, tuple(reversed(rt))))
+    return _t(jnp.reshape(x, _reshape_infer_target(tgt, x.shape)))
+
+register("Reshape", _reshape,
+         params={"shape": Param("shape", (), True),
+                 "reverse": Param("bool", False)},
+         aliases=("reshape",))
+
+
+def _flatten(attrs, octx, x):
+    return _t(jnp.reshape(x, (x.shape[0], -1)))
+
+def _flatten_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None]
+    n = 1
+    for d in s[1:]:
+        n *= d
+    return in_shapes, [(s[0], n)]
+
+register("Flatten", _flatten, aliases=("flatten",), infer_shape=_flatten_infer)
+
+
+def _transpose(attrs, octx, x):
+    axes = attrs["axes"]
+    return _t(jnp.transpose(x, axes if axes else None))
+
+register("transpose", _transpose, params={"axes": Param("shape", ())})
+
+
+def _expand_dims(attrs, octx, x):
+    return _t(jnp.expand_dims(x, attrs["axis"]))
+
+register("expand_dims", _expand_dims,
+         params={"axis": Param("int", None, True)})
+
+
+def _squeeze(attrs, octx, x):
+    ax = attrs["axis"]
+    return _t(jnp.squeeze(x, None if ax is None else tuple(ax)))
+
+register("squeeze", _squeeze, params={"axis": Param("shape", None)})
+
+
+def _slice(attrs, octx, x):
+    begin, end, step = attrs["begin"], attrs["end"], attrs["step"]
+    idx = []
+    for i in range(len(begin)):
+        b = begin[i]
+        e = end[i] if i < len(end) else None
+        s = step[i] if step and i < len(step) else None
+        idx.append(builtins_slice(b, e, s))
+    return _t(x[tuple(idx)])
+
+
+def builtins_slice(b, e, s):
+    return slice(None if b is None else int(b),
+                 None if e is None else int(e),
+                 None if s is None or s == 0 else int(s))
+
+
+def _parse_slice_list(v):
+    # begin/end attrs may contain None entries: "(0, None)"
+    if v is None:
+        return None
+    if isinstance(v, (tuple, list)):
+        return tuple(None if x is None else int(x) for x in v)
+    import ast
+    val = ast.literal_eval(str(v).replace("None", "None"))
+    if not isinstance(val, (tuple, list)):
+        val = (val,)
+    return tuple(None if x is None else int(x) for x in val)
+
+register("slice", _slice,
+         params={"begin": Param("any", None, True),
+                 "end": Param("any", None, True),
+                 "step": Param("any", None)},
+         aliases=("crop",))
+# patch parsers for slice's tolerant None-tuples
+_slice_schema = None
+from .registry import get_op as _get_op
+for _pname in ("begin", "end", "step"):
+    _get_op("slice").params[_pname].parse = _parse_slice_list  # type: ignore
+    _get_op("slice").params[_pname] = _get_op("slice").params[_pname]
+
+
+def _slice_axis(attrs, octx, x):
+    ax = attrs["axis"] % x.ndim
+    b = attrs["begin"] or 0
+    e = attrs["end"]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, None if e is None else e)
+    return _t(x[tuple(idx)])
+
+register("slice_axis", _slice_axis,
+         params={"axis": Param("int", None, True),
+                 "begin": Param("int", 0),
+                 "end": Param("int", None)})
+
+
+def _slice_like(attrs, octx, x, shape_like):
+    axes = attrs["axes"]
+    tgt = list(x.shape)
+    if not axes:
+        axes = tuple(range(min(x.ndim, shape_like.ndim)))
+    for a in axes:
+        tgt[a % x.ndim] = shape_like.shape[a % shape_like.ndim]
+    idx = tuple(slice(0, t) for t in tgt)
+    return _t(x[idx])
+
+register("slice_like", _slice_like, params={"axes": Param("shape", ())},
+         inputs=("data", "shape_like"))
+
+
+def _clip(attrs, octx, x):
+    return _t(jnp.clip(x, attrs["a_min"], attrs["a_max"]))
+
+register("clip", _clip, params={"a_min": Param("float", None, True),
+                                "a_max": Param("float", None, True)},
+         infer_shape=_same_shape_infer(1))
+
+
+def _repeat(attrs, octx, x):
+    return _t(jnp.repeat(x, attrs["repeats"], axis=attrs["axis"]))
+
+register("repeat", _repeat, params={"repeats": Param("int", None, True),
+                                    "axis": Param("int", None)})
+
+
+def _tile(attrs, octx, x):
+    return _t(jnp.tile(x, attrs["reps"]))
+
+register("tile", _tile, params={"reps": Param("shape", None, True)})
+
+
+def _reverse(attrs, octx, x):
+    return _t(jnp.flip(x, axis=tuple(attrs["axis"])))
+
+register("reverse", _reverse, params={"axis": Param("shape", None, True)},
+         aliases=("flip",))
+
+
+def _swapaxes(attrs, octx, x):
+    return _t(jnp.swapaxes(x, attrs["dim1"], attrs["dim2"]))
+
+register("SwapAxis", _swapaxes, params={"dim1": Param("int", 0),
+                                        "dim2": Param("int", 0)},
+         aliases=("swapaxes",))
+
+
+def _depth_to_space(attrs, octx, x):
+    b = attrs["block_size"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return _t(y.reshape(n, c // (b * b), h * b, w * b))
+
+register("depth_to_space", _depth_to_space,
+         params={"block_size": Param("int", None, True)})
+
+
+def _space_to_depth(attrs, octx, x):
+    b = attrs["block_size"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return _t(y.reshape(n, c * b * b, h // b, w // b))
+
+register("space_to_depth", _space_to_depth,
+         params={"block_size": Param("int", None, True)})
+
+
+def _stack(attrs, octx, *xs):
+    return _t(jnp.stack(xs, axis=attrs["axis"]))
+
+register("stack", _stack, params={"axis": Param("int", 0),
+                                  "num_args": Param("int", None, True)},
+         inputs=("arg",), key_var_num_args="num_args")
+
+
+def _concat(attrs, octx, *xs):
+    return _t(jnp.concatenate(xs, axis=attrs["dim"]))
+
+def _concat_infer(attrs, in_shapes):
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None]
+    dim = attrs["dim"]
+    proto = list(known[0])
+    filled = [list(proto) if s is None else list(s) for s in in_shapes]
+    total = sum(s[dim] for s in filled)
+    out = list(filled[0]); out[dim] = total
+    return [tuple(s) for s in filled], [tuple(out)]
+
+register("Concat", _concat,
+         params={"dim": Param("int", 1), "num_args": Param("int", None, True)},
+         inputs=("arg",), key_var_num_args="num_args",
+         aliases=("concat",), infer_shape=_concat_infer)
+
+
+def _split(attrs, octx, x):
+    n = attrs["num_outputs"]
+    ax = attrs["axis"]
+    parts = jnp.split(x, n, axis=ax)
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=ax) for p in parts]
+    return tuple(parts)
+
+def _split_noutputs(attrs):
+    return attrs["num_outputs"]
+
+_split_schema = register(
+    "SliceChannel", _split,
+    params={"num_outputs": Param("int", None, True),
+            "axis": Param("int", 1),
+            "squeeze_axis": Param("bool", False)},
+    aliases=("split",))
+_split_schema.num_outputs = _split_noutputs  # dynamic output count
+
+
+def _where(attrs, octx, cond, x, y):
+    return _t(jnp.where(cond != 0, x, y))
+
+register("where", _where, inputs=("condition", "x", "y"))
+
+
+def _pad(attrs, octx, x):
+    pw = attrs["pad_width"]
+    mode = attrs["mode"]
+    pads = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    if mode == "constant":
+        return _t(jnp.pad(x, pads, constant_values=attrs["constant_value"]))
+    if mode == "edge":
+        return _t(jnp.pad(x, pads, mode="edge"))
+    if mode == "reflect":
+        return _t(jnp.pad(x, pads, mode="reflect"))
+    raise MXNetError(f"Pad: unknown mode {mode}")
+
+register("Pad", _pad,
+         params={"mode": Param("str", "constant"),
+                 "pad_width": Param("shape", None, True),
+                 "constant_value": Param("float", 0.0)},
+         aliases=("pad",))
+
+
+def _broadcast_to(attrs, octx, x):
+    tgt = list(attrs["shape"])
+    for i, d in enumerate(tgt):
+        if d == 0:
+            tgt[i] = x.shape[i]
+    return _t(jnp.broadcast_to(x, tuple(tgt)))
+
+register("broadcast_to", _broadcast_to,
+         params={"shape": Param("shape", None, True)})
+
+
+def _broadcast_axis(attrs, octx, x):
+    axes = attrs["axis"]
+    sizes = attrs["size"]
+    if isinstance(axes, int):
+        axes, sizes = (axes,), (sizes,)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return _t(jnp.broadcast_to(x, tuple(tgt)))
+
+register("broadcast_axis", _broadcast_axis,
+         params={"axis": Param("shape", None, True),
+                 "size": Param("shape", None, True)},
+         aliases=("broadcast_axes",))
+
+
+def _broadcast_like(attrs, octx, x, like):
+    return _t(jnp.broadcast_to(x, like.shape))
+
+register("broadcast_like", _broadcast_like, inputs=("lhs", "rhs"))
+
+# ---------------------------------------------------------------------------
+# indexing (indexing_op.h)
+# ---------------------------------------------------------------------------
+
+def _take(attrs, octx, data, indices):
+    ax = attrs["axis"]
+    mode = attrs["mode"]
+    idx = indices.astype(jnp.int32)
+    n = data.shape[ax]
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, n)
+    return _t(jnp.take(data, idx, axis=ax))
+
+register("take", _take,
+         params={"axis": Param("int", 0), "mode": Param("str", "clip")},
+         inputs=("a", "indices"))
+
+
+def _batch_take(attrs, octx, data, indices):
+    idx = indices.astype(jnp.int32)
+    return _t(jnp.take_along_axis(data, idx[:, None], axis=1)[:, 0])
+
+register("batch_take", _batch_take, inputs=("a", "indices"))
+
+
+def _pick(attrs, octx, data, index):
+    ax = attrs["axis"]
+    idx = index.astype(jnp.int32)
+    if ax is None:
+        flat = data.reshape(-1)
+        return _t(jnp.take(flat, idx.reshape(-1)).reshape(index.shape))
+    ax = ax % data.ndim
+    idx_exp = jnp.expand_dims(idx, ax) if idx.ndim < data.ndim else idx
+    n = data.shape[ax]
+    idx_exp = jnp.clip(idx_exp, 0, n - 1)
+    out = jnp.take_along_axis(data, idx_exp, axis=ax)
+    if attrs["keepdims"]:
+        return _t(out)
+    return _t(jnp.squeeze(out, axis=ax))
+
+register("pick", _pick,
+         params={"axis": Param("int", -1), "keepdims": Param("bool", False)},
+         inputs=("data", "index"), aliases=("choose_element_0index",))
+
+
+def _gather_nd(attrs, octx, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return _t(data[tuple(idx[i] for i in range(m))])
+
+register("gather_nd", _gather_nd, inputs=("data", "indices"))
+
+
+def _scatter_nd(attrs, octx, data, indices):
+    shape = attrs["shape"]
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return _t(out.at[tuple(idx[i] for i in range(m))].set(data))
+
+register("scatter_nd", _scatter_nd,
+         params={"shape": Param("shape", None, True)},
+         inputs=("data", "indices"))
+
+
+def _one_hot(attrs, octx, indices):
+    from ..base import np_dtype
+    depth = attrs["depth"]
+    on, off = attrs["on_value"], attrs["off_value"]
+    idx = indices.astype(jnp.int32)
+    oh = jax.nn.one_hot(idx, depth)
+    out = oh * on + (1 - oh) * off
+    return _t(out.astype(np_dtype(attrs["dtype"])))
+
+register("one_hot", _one_hot,
+         params={"depth": Param("int", None, True),
+                 "on_value": Param("float", 1.0),
+                 "off_value": Param("float", 0.0),
+                 "dtype": Param("dtype", "float32")},
+         inputs=("indices",))
+
+
+def _diag(attrs, octx, x):
+    k = attrs["k"]
+    if x.ndim == 1:
+        return _t(jnp.diag(x, k=k))
+    return _t(jnp.diagonal(x, offset=k, axis1=-2, axis2=-1))
+
+register("diag", _diag, params={"k": Param("int", 0)})
+
+# ---------------------------------------------------------------------------
+# init ops (init_op.cc) — nullary; created via attrs only
+# ---------------------------------------------------------------------------
+
+def _np_dt(attrs):
+    from ..base import np_dtype
+    return np_dtype(attrs.get("dtype") or "float32")
+
+
+def _zeros(attrs, octx):
+    return _t(jnp.zeros(attrs["shape"], dtype=_np_dt(attrs)))
+
+register("_zeros", _zeros, params={"shape": Param("shape", (), True),
+                                   "dtype": Param("dtype", "float32")},
+         inputs=())
+
+
+def _ones(attrs, octx):
+    return _t(jnp.ones(attrs["shape"], dtype=_np_dt(attrs)))
+
+register("_ones", _ones, params={"shape": Param("shape", (), True),
+                                 "dtype": Param("dtype", "float32")},
+         inputs=())
+
+
+def _full(attrs, octx):
+    return _t(jnp.full(attrs["shape"], attrs["value"], dtype=_np_dt(attrs)))
+
+register("_full", _full, params={"shape": Param("shape", (), True),
+                                 "value": Param("float", 0.0, True),
+                                 "dtype": Param("dtype", "float32")},
+         inputs=())
+
+
+def _arange(attrs, octx):
+    start, stop, step = attrs["start"], attrs["stop"], attrs["step"]
+    a = jnp.arange(start, stop, step, dtype=_np_dt(attrs))
+    if attrs["repeat"] > 1:
+        a = jnp.repeat(a, attrs["repeat"])
+    return _t(a)
+
+register("_arange", _arange,
+         params={"start": Param("float", 0.0), "stop": Param("float", None),
+                 "step": Param("float", 1.0), "repeat": Param("int", 1),
+                 "dtype": Param("dtype", "float32")},
+         inputs=())
+
+
+def _eye(attrs, octx):
+    return _t(jnp.eye(attrs["N"], attrs["M"] or None, k=attrs["k"],
+                      dtype=_np_dt(attrs)))
+
+register("_eye", _eye, params={"N": Param("int", None, True),
+                               "M": Param("int", 0), "k": Param("int", 0),
+                               "dtype": Param("dtype", "float32")},
+         inputs=())
+
+
+def _zeros_like(attrs, octx, x):
+    return _t(jnp.zeros_like(x))
+
+register("zeros_like", _zeros_like, infer_shape=_same_shape_infer(1))
+
+
+def _ones_like(attrs, octx, x):
+    return _t(jnp.ones_like(x))
+
+register("ones_like", _ones_like, infer_shape=_same_shape_infer(1))
+
+# ---------------------------------------------------------------------------
+# ordering (ordering_op)
+# ---------------------------------------------------------------------------
+
+def _sort(attrs, octx, x):
+    ax = attrs["axis"]
+    y = jnp.sort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        y = jnp.flip(y, axis=ax if ax is not None else tuple(range(x.ndim)))
+    return _t(y)
+
+register("sort", _sort, params={"axis": Param("int", -1),
+                                "is_ascend": Param("bool", True)})
+
+
+def _argsort(attrs, octx, x):
+    ax = attrs["axis"]
+    y = jnp.argsort(x, axis=ax)
+    if not attrs["is_ascend"]:
+        y = jnp.flip(y, axis=ax if ax is not None else tuple(range(x.ndim)))
+    return _t(y.astype(_np_dt(attrs)))
+
+register("argsort", _argsort, params={"axis": Param("int", -1),
+                                      "is_ascend": Param("bool", True),
+                                      "dtype": Param("dtype", "float32")})
+
+
+def _topk_compute(attrs, octx, x):
+    ax = attrs["axis"]
+    k = attrs["k"]
+    ret = attrs["ret_typ"]
+    asc = attrs["is_ascend"]
+    if ax is None:
+        x2 = x.reshape(-1)
+        ax2 = 0
+    else:
+        x2 = x
+        ax2 = ax % x.ndim
+    xm = jnp.moveaxis(x2, ax2, -1)
+    vals, idxs = jax.lax.top_k(jnp.negative(xm) if asc else xm, k)
+    if asc:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax2)
+    idxs = jnp.moveaxis(idxs, -1, ax2)
+    if ret == "value":
+        return _t(vals)
+    if ret == "both":
+        return (vals, idxs.astype(_np_dt(attrs)))
+    if ret == "mask":
+        oh = jnp.sum(jax.nn.one_hot(idxs, xm.shape[-1], dtype=x.dtype), axis=-2)
+        return _t(jnp.moveaxis(oh, -1, ax2) if ax is not None else oh)
+    return _t(idxs.astype(_np_dt(attrs)))
+
+
+def _topk_noutputs(attrs):
+    return 2 if attrs["ret_typ"] == "both" else 1
+
+_topk_schema = register("topk", _topk_compute,
+                        params={"axis": Param("int", -1),
+                                "k": Param("int", 1),
+                                "ret_typ": Param("str", "indices"),
+                                "is_ascend": Param("bool", False),
+                                "dtype": Param("dtype", "float32")})
+_topk_schema.num_outputs = _topk_noutputs
+
+# shape-only ops
+def _shape_array(attrs, octx, x):
+    return _t(jnp.asarray(x.shape, dtype=jnp.int64))
+
+register("shape_array", _shape_array)
+
+
+def _size_array(attrs, octx, x):
+    return _t(jnp.asarray([x.size], dtype=jnp.int64))
+
+register("size_array", _size_array)
+
+
+def _contrib_div_sqrt_dim(attrs, octx, x):
+    # transformer helper (src/operator/contrib/transformer.cc:34)
+    return _t(x / jnp.sqrt(jnp.asarray(x.shape[-1], dtype=x.dtype)))
+
+register("_contrib_div_sqrt_dim", _contrib_div_sqrt_dim)

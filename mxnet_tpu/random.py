@@ -1,0 +1,36 @@
+"""Global RNG state (parity: mx.random.seed, src/common/random_generator).
+
+The reference keeps per-device curand/mt19937 resources handed to ops via
+ResourceRequest::kRandom (include/mxnet/resource.h:42). TPU-natively, RNG is a
+jax PRNG key threaded explicitly: a global key is split per stochastic op
+invocation, so imperative code gets fresh randomness while each compiled
+executable stays pure (key is a traced argument, not a burned-in constant).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state, ctx=None):
+    """Seed the global RNG (parity: python/mxnet/random.py seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def _key():
+    k = getattr(_state, "key", None)
+    if k is None:
+        k = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.key = k
+    return k
+
+
+def next_key():
+    k = _key()
+    k, sub = jax.random.split(k)
+    _state.key = k
+    return sub

@@ -1,0 +1,186 @@
+"""Autograd correctness (parity model: tests/python/unittest/test_autograd.py
++ numeric gradient checking pattern from python/mxnet/test_utils.py:792)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central-difference numeric gradient of scalar-output f wrt numpy x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_broadcast_backward():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(4, 2).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.dot(x, w)
+        z = nd.sum(nd.relu(y))
+    z.backward()
+    # numeric check
+    xn, wn = x.asnumpy(), w.asnumpy()
+    gx = numeric_grad(lambda v: np.maximum(v @ wn, 0).sum(), xn)
+    gw = numeric_grad(lambda v: np.maximum(xn @ v, 0).sum(), wn)
+    assert np.allclose(x.grad.asnumpy(), gx, rtol=1e-2, atol=1e-3)
+    assert np.allclose(w.grad.asnumpy(), gw, rtol=1e-2, atol=1e-3)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = x * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [12.0])  # 3 * 2x
+
+
+def test_detach_blocks_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = nd.BlockGrad(y) * x
+    z.backward()
+    # d/dx [stop(2x) * x] = 2x = 6
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = nd.sum(x * x)
+    (gx,) = ag.grad(y, x, retain_graph=True)
+    assert np.allclose(gx.asnumpy(), 2 * x.asnumpy())
+
+
+def test_training_flags():
+    assert not ag.is_training()
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_training()
+        assert ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+            assert ag.is_recording()
+    with ag.pause():
+        assert not ag.is_recording()
+    with ag.train_mode():
+        assert ag.is_training()
+
+
+def test_softmax_output_custom_backward():
+    """SoftmaxOutput's grad must be (p - onehot(label)) regardless of head
+    grad — the reference contract (src/operator/softmax_output-inl.h)."""
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    lbl = nd.array([0.0, 1.0, 2.0, 1.0])
+    x.attach_grad()
+    with ag.record():
+        out = nd.SoftmaxOutput(x, lbl)
+    out.backward()
+    p = out.asnumpy()
+    oh = np.eye(3, dtype=np.float32)[lbl.asnumpy().astype(int)]
+    assert np.allclose(x.grad.asnumpy(), p - oh, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_backward_numeric():
+    np.random.seed(1)
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 2, 3, 3).astype(np.float32)
+    xa, wa = nd.array(x), nd.array(w)
+    xa.attach_grad()
+    wa.attach_grad()
+    with ag.record():
+        y = nd.Convolution(xa, wa, kernel=(3, 3), num_filter=2, no_bias=True)
+        loss = nd.sum(y * y)
+    loss.backward()
+
+    def f(wv):
+        out = nd.Convolution(nd.array(x), nd.array(wv), kernel=(3, 3),
+                             num_filter=2, no_bias=True).asnumpy()
+        return (out * out).sum()
+
+    gw = numeric_grad(f, w, eps=1e-2)
+    assert np.allclose(wa.grad.asnumpy(), gw, rtol=5e-2, atol=1e-1)
+
+
+def test_batchnorm_backward_shapes():
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32))
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    for v in (x, gamma, beta):
+        v.attach_grad()
+    with ag.record():
+        y = nd.BatchNorm(x, gamma, beta, mm, mv, fix_gamma=False)
+        loss = nd.sum(y)
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert gamma.grad.shape == (3,)
+    assert beta.grad.shape == (3,)
+    assert np.allclose(beta.grad.asnumpy(), 16.0, rtol=1e-4)
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert np.allclose(g.asnumpy(), [10.0])
+
+
+def test_second_use_of_input():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x * 3  # x used by two nodes
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [7.0])
+
+
+def test_embedding_backward():
+    w = nd.array(np.random.rand(5, 3).astype(np.float32))
+    w.attach_grad()
+    idx = nd.array([1.0, 1.0, 3.0])
+    with ag.record():
+        e = nd.Embedding(idx, w, input_dim=5, output_dim=3)
+        loss = nd.sum(e)
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert np.allclose(g[1], 2.0)
+    assert np.allclose(g[3], 1.0)
+    assert np.allclose(g[0], 0.0)

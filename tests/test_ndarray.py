@@ -1,0 +1,230 @@
+"""NDArray core semantics (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert np.all(x.asnumpy() == 0)
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.array([[1, 2], [3, 4]])
+    assert z.shape == (2, 2)
+    assert z.dtype == np.float32  # float64 downcast to default dtype
+    f = nd.full((2, 2), 7.5)
+    assert np.allclose(f.asnumpy(), 7.5)
+    a = nd.arange(10)
+    assert np.allclose(a.asnumpy(), np.arange(10))
+
+
+def test_arithmetic_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    assert np.allclose((a + b).asnumpy(), a.asnumpy() + b.asnumpy())
+    assert np.allclose((a - b).asnumpy(), a.asnumpy() - b.asnumpy())
+    assert np.allclose((a * b).asnumpy(), a.asnumpy() * b.asnumpy())
+    assert np.allclose((a / b).asnumpy(), a.asnumpy() / b.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert np.allclose((2 + a).asnumpy(), 2 + a.asnumpy())
+    assert np.allclose((2 - a).asnumpy(), 2 - a.asnumpy())
+    assert np.allclose((2 / a).asnumpy(), 2 / a.asnumpy())
+    assert np.allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_comparison_returns_input_dtype():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([1.0, 5.0, 3.0])
+    eq = (a == b)
+    assert eq.dtype == np.float32  # MXNet semantics: not bool
+    assert np.allclose(eq.asnumpy(), [1.0, 0.0, 1.0])
+    assert np.allclose((a > 1.5).asnumpy(), [0.0, 1.0, 1.0])
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    assert np.allclose(a.asnumpy(), 2.0)
+    a *= 3
+    assert np.allclose(a.asnumpy(), 6.0)
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.allclose(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    assert np.allclose(a[1, 2].asnumpy(), 6)
+    a[0] = 100.0
+    assert np.allclose(a.asnumpy()[0], 100.0)
+    a[1, 1] = -1.0
+    assert a.asnumpy()[1, 1] == -1.0
+    a[:] = 0.0
+    assert np.all(a.asnumpy() == 0)
+
+
+def test_setitem_array_value():
+    a = nd.zeros((3, 4))
+    a[1] = nd.ones((4,)) * 5
+    assert np.allclose(a.asnumpy()[1], 5.0)
+    a[0:2] = np.arange(8).reshape(2, 4)
+    assert np.allclose(a.asnumpy()[0:2], np.arange(8).reshape(2, 4))
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, -3)).shape == (2, 12)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert a.reshape((6, 4)).shape == (6, 4)
+
+
+def test_shape_methods():
+    a = nd.zeros((2, 3, 4))
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    b = nd.zeros((2, 1, 4))
+    assert b.squeeze(axis=(1,)).shape == (2, 4)
+    assert b.broadcast_to((2, 5, 4)).shape == (2, 5, 4)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert np.allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    assert np.allclose(a.mean(axis=1).asnumpy(), x.mean(axis=1), rtol=1e-5)
+    assert np.allclose(a.max(axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)))
+    assert np.allclose(nd.sum(a, axis=0, keepdims=True).asnumpy(),
+                       x.sum(axis=0, keepdims=True), rtol=1e-5)
+    assert np.allclose(a.argmax(axis=1).asnumpy(), x.argmax(axis=1))
+    assert np.allclose(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                       x.sum(axis=(0, 2)), rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    assert np.allclose(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                       x @ y, rtol=1e-5)
+    assert np.allclose(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+        x @ y, rtol=1e-5)
+    bx = np.random.rand(2, 3, 4).astype(np.float32)
+    by = np.random.rand(2, 4, 5).astype(np.float32)
+    assert np.allclose(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                       bx @ by, rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=1)
+    assert c.shape == (2, 6)
+    parts = nd.split(c, num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    assert np.allclose(parts[0].asnumpy(), 1.0)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_pick_onehot():
+    w = nd.array(np.arange(20).reshape(5, 4))
+    idx = nd.array([0, 3], dtype="int32")
+    t = nd.take(w, idx)
+    assert t.shape == (2, 4)
+    assert np.allclose(t.asnumpy()[1], [12, 13, 14, 15])
+    data = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    p = nd.pick(data, nd.array([0, 1]), axis=1)
+    assert np.allclose(p.asnumpy(), [1.0, 4.0])
+    oh = nd.one_hot(nd.array([1, 0]), depth=3)
+    assert np.allclose(oh.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3.0, 2.0]])
+    both = nd.topk(x, k=1, ret_typ="both")
+    assert np.allclose(both[0].asnumpy(), [[3.0]])
+    assert np.allclose(both[1].asnumpy(), [[0.0]])
+    assert np.allclose(nd.sort(x).asnumpy(), [[1.0, 2.0, 3.0]])
+    assert np.allclose(nd.argsort(x).asnumpy(), [[1.0, 2.0, 0.0]])
+
+
+def test_astype_cast():
+    a = nd.array([1.6, 2.4])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype(np.float16)
+    assert c.dtype == np.float16
+
+
+def test_context_placement():
+    a = nd.zeros((2, 2), ctx=mx.cpu(0))
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    c = a.copyto(mx.cpu(0))
+    assert c is not a
+    assert np.allclose(c.asnumpy(), a.asnumpy())
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert set(back) == {"w", "b"}
+    assert np.allclose(back["w"].asnumpy(), 1.0)
+    lst = [nd.ones((2,)), nd.zeros((1,))]
+    nd.save(f, lst)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 2
+
+
+def test_elementwise_math():
+    x = np.random.rand(3, 3).astype(np.float32) + 0.5
+    a = nd.array(x)
+    assert np.allclose(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert np.allclose(nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert np.allclose(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert np.allclose(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x), rtol=1e-4)
+    assert np.allclose(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert np.allclose(nd.relu(nd.array([-1.0, 2.0])).asnumpy(), [0.0, 2.0])
+    assert np.allclose(nd.clip(a, 0.6, 0.9).asnumpy(), np.clip(x, 0.6, 0.9))
+
+
+def test_wait_and_waitall():
+    a = nd.ones((4, 4))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert np.allclose(b.asnumpy(), 2.0)
+
+
+def test_slice_ops():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    s = nd.slice(a, begin=(0, 1), end=(2, 3))
+    assert np.allclose(s.asnumpy(), x[0:2, 1:3])
+    sa = nd.slice_axis(a, axis=2, begin=1, end=3)
+    assert np.allclose(sa.asnumpy(), x[:, :, 1:3])
+
+
+def test_where_tile_repeat():
+    cond = nd.array([1.0, 0.0])
+    x = nd.array([1.0, 2.0])
+    y = nd.array([3.0, 4.0])
+    assert np.allclose(nd.where(cond, x, y).asnumpy(), [1.0, 4.0])
+    assert nd.tile(x, reps=(2, 2)).shape == (2, 4)
+    assert np.allclose(nd.repeat(x, repeats=2).asnumpy(), [1, 1, 2, 2])

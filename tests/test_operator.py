@@ -1,0 +1,305 @@
+"""Operator correctness (parity model: tests/python/unittest/test_operator.py —
+golden numpy asserts; numeric gradient checks live in test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = np.random.rand(3, 10).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=3,
+                             no_bias=True)
+    assert np.allclose(out2.asnumpy(), x @ w.T, rtol=1e-4)
+    # flatten=True collapses trailing dims
+    x4 = np.random.rand(2, 5, 2).astype(np.float32)
+    out3 = nd.FullyConnected(nd.array(x4), nd.array(w), nd.array(b),
+                             num_hidden=3)
+    assert out3.shape == (2, 3)
+    # flatten=False applies to last axis
+    wl = np.random.rand(3, 2).astype(np.float32)
+    out4 = nd.FullyConnected(nd.array(x4), nd.array(wl), nd.array(b),
+                             num_hidden=3, flatten=False)
+    assert out4.shape == (2, 5, 3)
+
+
+def test_convolution():
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, dtype=np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    # golden check vs explicit correlation
+    ref = np.zeros((2, 4, 6, 6), dtype=np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(6):
+                for j in range(6):
+                    ref[n, f, i, j] = np.sum(x[n, :, i:i + 3, j:j + 3] * w[f])
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # stride + pad
+    out2 = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                          kernel=(3, 3), num_filter=4, stride=(2, 2),
+                          pad=(1, 1))
+    assert out2.shape == (2, 4, 4, 4)
+    # grouped
+    wg = np.random.rand(4, 1, 3, 3).astype(np.float32)
+    outg = nd.Convolution(nd.array(np.random.rand(2, 4, 8, 8).astype(np.float32)),
+                          nd.array(wg), nd.array(b), kernel=(3, 3),
+                          num_filter=4, num_group=4)
+    assert outg.shape == (2, 4, 6, 6)
+
+
+def test_deconvolution_inverts_shape():
+    x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 4, 3, 3).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=4, no_bias=True)
+    assert out.shape == (2, 4, 7, 7)
+    out2 = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                            num_filter=4, stride=(2, 2), pad=(1, 1),
+                            no_bias=True)
+    assert out2.shape == (2, 4, 9, 9)
+
+
+def test_pooling():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="max",
+                     stride=(2, 2))
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert np.allclose(out.asnumpy(), ref)
+    outa = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg",
+                      stride=(2, 2))
+    refa = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert np.allclose(outa.asnumpy(), refa, rtol=1e-5)
+    outg = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg",
+                      kernel=(2, 2))
+    assert outg.shape == (1, 1, 1, 1)
+    assert np.allclose(outg.asnumpy().ravel(), x.mean(), rtol=1e-5)
+    # 'full' (ceil) convention: 5x5 input, k=2,s=2 -> 3x3 out
+    x5 = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    outf = nd.Pooling(nd.array(x5), kernel=(2, 2), stride=(2, 2),
+                      pooling_convention="full", pool_type="max")
+    assert outf.shape == (1, 1, 3, 3)
+
+
+def test_activation_family():
+    x = np.array([-2.0, -0.5, 0.0, 1.5], dtype=np.float32)
+    a = nd.array(x)
+    assert np.allclose(nd.Activation(a, act_type="relu").asnumpy(),
+                       np.maximum(x, 0))
+    assert np.allclose(nd.Activation(a, act_type="tanh").asnumpy(),
+                       np.tanh(x), rtol=1e-5)
+    assert np.allclose(nd.Activation(a, act_type="softrelu").asnumpy(),
+                       np.log1p(np.exp(x)), rtol=1e-5)
+    assert np.allclose(nd.LeakyReLU(a, act_type="leaky", slope=0.1).asnumpy(),
+                       np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    assert np.allclose(nd.LeakyReLU(a, act_type="elu", slope=1.0).asnumpy(),
+                       np.where(x > 0, x, np.exp(x) - 1), rtol=1e-5)
+    g = nd.array([0.25])
+    prelu = nd.LeakyReLU(nd.array(x.reshape(1, 4)), g, act_type="prelu")
+    assert prelu.shape == (1, 4)
+
+
+def test_softmax_ops():
+    x = np.random.rand(3, 5).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-5)
+    assert np.allclose(nd.log_softmax(nd.array(x)).asnumpy(), np.log(ref),
+                       rtol=1e-4)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_softmax_output_forward():
+    x = np.random.rand(4, 3).astype(np.float32)
+    lbl = np.array([0, 1, 2, 1], dtype=np.float32)
+    out = nd.SoftmaxOutput(nd.array(x), nd.array(lbl))
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert np.allclose(out.asnumpy(), e / e.sum(axis=1, keepdims=True),
+                       rtol=1e-5)
+
+
+def test_batchnorm_train_and_inference():
+    np.random.seed(0)
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32) * 5
+    gamma = np.ones(3, dtype=np.float32)
+    beta = np.zeros(3, dtype=np.float32)
+    mmean = nd.zeros((3,))
+    mvar = nd.ones((3,))
+    with mx.autograd.record(train_mode=True):
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mmean, mvar, fix_gamma=False, momentum=0.9)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    ref = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-3)
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # moving stats updated in-place (aux mutation semantics)
+    assert np.allclose(mmean.asnumpy(), 0.1 * bm, rtol=1e-4)
+    assert np.allclose(mvar.asnumpy(), 0.9 + 0.1 * bv, rtol=1e-4)
+    # inference path uses moving stats
+    out_inf = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mmean, mvar, fix_gamma=False)
+    refi = (x - mmean.asnumpy().reshape(1, 3, 1, 1)) / \
+        np.sqrt(mvar.asnumpy().reshape(1, 3, 1, 1) + 1e-3)
+    assert np.allclose(out_inf.asnumpy(), refi, rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    b = np.random.rand(6).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mu = x.mean(axis=-1, keepdims=True)
+    sig = x.var(axis=-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(sig + 1e-5) * g + b
+    assert np.allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout():
+    x = nd.ones((100, 100))
+    # inference: identity
+    out = nd.Dropout(x, p=0.5)
+    assert np.allclose(out.asnumpy(), 1.0)
+    # training: ~half dropped, scaled by 1/keep
+    with mx.autograd.record():
+        out_t = nd.Dropout(x, p=0.5)
+    a = out_t.asnumpy()
+    frac = (a == 0).mean()
+    assert 0.4 < frac < 0.6
+    assert np.allclose(a[a != 0], 2.0, rtol=1e-5)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = nd.array([[1, 2], [3, 4]])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert out.shape == (2, 2, 4)
+    assert np.allclose(out.asnumpy()[0, 0], w[1])
+
+
+def test_lrn():
+    x = np.random.rand(2, 8, 4, 4).astype(np.float32)
+    out = nd.LRN(nd.array(x), nsize=5)
+    assert out.shape == x.shape
+    # golden: denominator for channel c sums over window of 5 channels
+    c = 3
+    acc = (x[:, 1:6] ** 2).sum(axis=1)
+    ref = x[:, c] / (2.0 + (1e-4 / 5) * acc) ** 0.75
+    assert np.allclose(out.asnumpy()[:, c], ref, rtol=1e-4)
+
+
+def test_regression_outputs():
+    x = np.random.rand(4, 3).astype(np.float32)
+    lbl = np.random.rand(4, 3).astype(np.float32)
+    out = nd.LinearRegressionOutput(nd.array(x), nd.array(lbl))
+    assert np.allclose(out.asnumpy(), x)
+    out2 = nd.LogisticRegressionOutput(nd.array(x), nd.array(lbl))
+    assert np.allclose(out2.asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    out3 = nd.MAERegressionOutput(nd.array(x), nd.array(lbl))
+    assert np.allclose(out3.asnumpy(), x)
+
+
+def test_sequence_ops():
+    # (seq_len, batch, feat)
+    x = np.random.rand(4, 2, 3).astype(np.float32)
+    sl = nd.array([2.0, 4.0])
+    masked = nd.SequenceMask(nd.array(x), sl, use_sequence_length=True,
+                             value=-1.0)
+    m = masked.asnumpy()
+    assert np.allclose(m[:2, 0], x[:2, 0])
+    assert np.allclose(m[2:, 0], -1.0)
+    assert np.allclose(m[:, 1], x[:, 1])
+    last = nd.SequenceLast(nd.array(x), sl, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x[1, 0])
+    assert np.allclose(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), sl, use_sequence_length=True)
+    assert np.allclose(rev.asnumpy()[0, 0], x[1, 0])
+    assert np.allclose(rev.asnumpy()[1, 0], x[0, 0])
+    assert np.allclose(rev.asnumpy()[2:, 0], x[2:, 0])
+
+
+def test_upsampling():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    assert np.allclose(out.asnumpy()[0, 0],
+                       [[0, 0, 1, 1], [0, 0, 1, 1],
+                        [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_instance_norm_l2norm():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    g = np.ones(3, dtype=np.float32)
+    b = np.zeros(3, dtype=np.float32)
+    out = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b))
+    mu = x.mean(axis=2, keepdims=True)
+    v = x.var(axis=2, keepdims=True)
+    assert np.allclose(out.asnumpy(), (x - mu) / np.sqrt(v + 1e-3), rtol=1e-3)
+    l2 = nd.L2Normalization(nd.array(x), mode="instance")
+    nrm = np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10)
+    assert np.allclose(l2.asnumpy(), x / nrm, rtol=1e-4)
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    a = u.asnumpy()
+    assert a.min() >= 0 and a.max() <= 1
+    assert abs(a.mean() - 0.5) < 0.05
+    n = nd.random.normal(2.0, 3.0, shape=(5000,))
+    b = n.asnumpy()
+    assert abs(b.mean() - 2.0) < 0.2
+    assert abs(b.std() - 3.0) < 0.2
+    # reproducibility under seed
+    mx.random.seed(7)
+    x1 = nd.random.uniform(shape=(10,)).asnumpy()
+    mx.random.seed(7)
+    x2 = nd.random.uniform(shape=(10,)).asnumpy()
+    assert np.allclose(x1, x2)
+    # sample_* with array params
+    lo = nd.array([0.0, 10.0])
+    hi = nd.array([1.0, 20.0])
+    s = nd.random.uniform(lo, hi, shape=(100,))
+    assert s.shape == (2, 100)
+    sn = s.asnumpy()
+    assert sn[0].max() <= 1.0 and sn[1].min() >= 10.0
+    m = nd.random.multinomial(nd.array([0.0, 0.0, 1.0]), shape=(20,))
+    assert np.all(m.asnumpy() == 2)
+
+
+def test_linalg_ops():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    out = nd.linalg_gemm2(nd.array(a), nd.array(b))
+    assert np.allclose(out.asnumpy(), a @ b, rtol=1e-4)
+    spd = np.eye(3, dtype=np.float32) * 4
+    l = nd.linalg_potrf(nd.array(spd))
+    assert np.allclose(l.asnumpy(), np.eye(3) * 2, atol=1e-5)
+    sld = nd.linalg_sumlogdiag(nd.array(spd + np.eye(3, dtype=np.float32)))
+    assert np.allclose(sld.asnumpy(), 3 * np.log(5), rtol=1e-5)
+
+
+def test_cast_gather_scatter():
+    data = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    idx = nd.array([[0, 1], [1, 0]])
+    g = nd.gather_nd(data, idx)
+    assert np.allclose(g.asnumpy(), [2.0, 3.0])
+    s = nd.scatter_nd(nd.array([9.0, 8.0]), idx, shape=(2, 2))
+    assert np.allclose(s.asnumpy(), [[0, 9], [8, 0]])
+
+
+def test_pad_op():
+    x = np.random.rand(1, 1, 2, 2).astype(np.float32)
+    out = nd.Pad(nd.array(x), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                 constant_value=5.0)
+    assert out.shape == (1, 1, 4, 4)
+    assert out.asnumpy()[0, 0, 0, 0] == 5.0
+    assert np.allclose(out.asnumpy()[0, 0, 1:3, 1:3], x[0, 0])
