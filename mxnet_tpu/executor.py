@@ -1,0 +1,314 @@
+"""Executor — binds a Symbol to devices/arrays and runs it.
+
+Parity target: src/executor/graph_executor.{h,cc} + python/mxnet/executor.py
+(SURVEY.md §2.1, §3.4). The reference's Init pipeline (gradient graph, device
+placement, shape/type inference, PlanMemory, AttachOpExecs, engine op
+creation) collapses TPU-natively into: walk the Symbol once to emit a pure
+jax function of (args, aux, rng) → (outputs, new_aux), then let XLA do
+placement/memory-planning/fusion. `forward(is_train=True)` runs jax.vjp over
+that function so `backward()` is the transposed XLA module — the whole
+fwd+bwd is two compiled executables instead of per-op engine pushes.
+
+grad_req: 'write' stores grads, 'add' accumulates into the bound grad arrays
+(the reference's kAddTo), 'null' skips.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ops.registry import OpCtx
+
+__all__ = ["Executor"]
+
+
+def _build_runner(symbol, is_train):
+    """Emit run(arg_values: tuple, aux_values: tuple, rng) ->
+    (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller."""
+    topo = symbol._topo()
+    args_n, aux_n = symbol._input_vars()
+    arg_index = {id(n): i for i, n in enumerate(args_n)}
+    aux_index = {id(n): i for i, n in enumerate(aux_n)}
+    node_pos = {id(n): i for i, n in enumerate(topo)}
+    out_entries = [(node_pos[id(n)], i) for (n, i) in symbol._outputs]
+
+    # count rng consumers for key splitting
+    rng_nodes = [id(n) for n in topo
+                 if n.op is not None and n.op.needs_rng]
+    rng_slot = {nid: i for i, nid in enumerate(rng_nodes)}
+
+    def run(arg_values, aux_values, rng):
+        vals = [None] * len(topo)
+        new_aux = list(aux_values)
+        keys = jax.random.split(rng, max(1, len(rng_nodes))) \
+            if rng_nodes else None
+        for pos, node in enumerate(topo):
+            if node.op is None:
+                if id(node) in aux_index:
+                    vals[pos] = (new_aux[aux_index[id(node)]],)
+                else:
+                    vals[pos] = (arg_values[arg_index[id(node)]],)
+                continue
+            parsed = node.op.parse_attrs(node.attrs)
+            ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
+            key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
+            octx = OpCtx(is_train=is_train, rng=key)
+            res = node.op.fcompute(parsed, octx, *ins)
+            if not isinstance(res, tuple):
+                res = (res,)
+            n_out = node.num_outputs()
+            vals[pos] = res[:n_out]
+            if node.op.mutates_aux and is_train:
+                for j, aux_i in enumerate(node.op.aux_indices):
+                    n2, _ = node.inputs[aux_i]
+                    if id(n2) in aux_index:
+                        new_aux[aux_index[id(n2)]] = res[n_out + j]
+        outputs = tuple(vals[p][i] for (p, i) in out_entries)
+        return outputs, tuple(new_aux)
+
+    return run
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req_dict,
+                 aux_dict):
+        from .ndarray.ndarray import NDArray
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self._grad_req = grad_req_dict
+        self.aux_dict = aux_dict
+        self.arg_arrays = [arg_dict[n] for n in self._arg_names]
+        self.grad_arrays = [grad_dict.get(n) for n in self._arg_names]
+        self.aux_arrays = [aux_dict[n] for n in self._aux_names]
+        self.outputs = []
+        self._monitor_callback = None
+
+        self._run_train = None
+        self._run_eval = None
+        self._jit_eval = None
+        self._jit_fwd_train = None
+        self._vjp_fn = None
+
+    # -- construction helpers ----------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+        from .ndarray import ndarray as ndmod
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        arg_dict, grad_dict, req_dict = {}, {}, {}
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, dict):
+            reqs = {n: grad_req.get(n, "null") for n in arg_names}
+        else:
+            reqs = {n: r for n, r in zip(arg_names, grad_req)}
+        for n, s in zip(arg_names, arg_shapes):
+            dt = type_dict.get(n, "float32")
+            arg_dict[n] = ndmod.zeros(s, ctx=ctx, dtype=dt)
+            if reqs[n] != "null":
+                grad_dict[n] = ndmod.zeros(s, ctx=ctx, dtype=dt)
+            req_dict[n] = reqs[n]
+        aux_dict = {n: ndmod.zeros(s, ctx=ctx)
+                    for n, s in zip(aux_names, aux_shapes)}
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        from .ndarray.ndarray import NDArray
+        from .ndarray import ndarray as ndmod
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args)
+        missing = [n for n in arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, args_grad))
+        else:
+            grad_dict = dict(args_grad)
+        if isinstance(grad_req, str):
+            req = {n: (grad_req if n in grad_dict or args_grad is None
+                       else "null") for n in arg_names}
+            if args_grad is None:
+                req = {n: "null" for n in arg_names}
+        elif isinstance(grad_req, dict):
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        else:
+            req = dict(zip(arg_names, grad_req))
+        if aux_states is None:
+            aux_dict = {}
+            if aux_names:
+                _, _, aux_shapes = symbol.infer_shape(
+                    **{n: a.shape for n, a in arg_dict.items()})
+                aux_dict = {n: ndmod.zeros(s, ctx=ctx)
+                            for n, s in zip(aux_names, aux_shapes)}
+        elif isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        else:
+            aux_dict = dict(aux_states)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
+
+    # -- execution ----------------------------------------------------------
+    def _arg_values(self):
+        return tuple(self.arg_dict[n]._data for n in self._arg_names)
+
+    def _aux_values(self):
+        return tuple(self.aux_dict[n]._data for n in self._aux_names)
+
+    def forward(self, is_train=False, **kwargs):
+        from .ndarray.ndarray import NDArray
+        from . import random as _random
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k}")
+            self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                else jnp.asarray(v)
+
+        rng = _random.next_key()
+        if self._monitor_callback is not None:
+            return self._forward_monitored(is_train, rng)
+        if is_train:
+            if self._run_train is None:
+                # jit composes with vjp: the primal(+residuals) and transpose
+                # both run as compiled XLA executables
+                self._run_train = jax.jit(_build_runner(self._symbol, True))
+            run = self._run_train
+            outputs, vjp_fn, new_aux = jax.vjp(
+                lambda a: run(a, self._aux_values(), rng),
+                self._arg_values(), has_aux=True)
+            self._vjp_fn = vjp_fn
+        else:
+            if self._jit_eval is None:
+                run_eval = _build_runner(self._symbol, False)
+                self._jit_eval = jax.jit(run_eval)
+            outputs, new_aux = self._jit_eval(
+                self._arg_values(), self._aux_values(), rng)
+            self._vjp_fn = None
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outputs]
+        return self.outputs
+
+    def _forward_monitored(self, is_train, rng):
+        """Un-fused eager execution calling the monitor per node (parity:
+        executor monitor callback, graph_executor.cc:1451)."""
+        from .ndarray.ndarray import NDArray
+        symbol = self._symbol
+        topo = symbol._topo()
+        args_n, aux_n = symbol._input_vars()
+        arg_index = {id(n): i for i, n in enumerate(args_n)}
+        aux_index = {id(n): i for i, n in enumerate(aux_n)}
+        node_pos = {id(n): i for i, n in enumerate(topo)}
+        vals = [None] * len(topo)
+        argv, auxv = self._arg_values(), list(self._aux_values())
+        for pos, node in enumerate(topo):
+            if node.op is None:
+                vals[pos] = ((auxv[aux_index[id(node)]],)
+                             if id(node) in aux_index
+                             else (argv[arg_index[id(node)]],))
+                continue
+            parsed = node.op.parse_attrs(node.attrs)
+            ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
+            key = jax.random.fold_in(rng, pos) if node.op.needs_rng else None
+            res = node.op.fcompute(parsed, OpCtx(is_train=is_train, rng=key),
+                                   *ins)
+            if not isinstance(res, tuple):
+                res = (res,)
+            n_out = node.num_outputs()
+            vals[pos] = res[:n_out]
+            for i in range(n_out):
+                out_name = f"{node.name}_output{i if n_out > 1 else ''}" \
+                    if n_out > 1 else f"{node.name}_output"
+                self._monitor_callback(out_name, NDArray(res[i]))
+            if node.op.mutates_aux and is_train:
+                for j, aux_i in enumerate(node.op.aux_indices):
+                    n2, _ = node.inputs[aux_i]
+                    if id(n2) in aux_index:
+                        auxv[aux_index[id(n2)]] = res[n_out + j]
+        out_entries = [(node_pos[id(n)], i) for (n, i) in symbol._outputs]
+        for n, v in zip(self._aux_names, auxv):
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(vals[p][i]) for (p, i) in out_entries]
+        self._vjp_fn = None
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        from .ndarray.ndarray import NDArray
+        if self._vjp_fn is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            grads_in = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            grads_in = tuple(g._data if isinstance(g, NDArray)
+                             else jnp.asarray(g) for g in out_grads)
+        (arg_grads,) = self._vjp_fn(grads_in)
+        for n, g in zip(self._arg_names, arg_grads):
+            req = self._grad_req.get(n, "null")
+            if req == "null" or n not in self.grad_dict:
+                continue
+            if req == "add":
+                self.grad_dict[n]._data = self.grad_dict[n]._data + g
+            else:
+                self.grad_dict[n]._data = g
+
+    # -- parity helpers ------------------------------------------------------
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data.astype(
+                    self.arg_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {k}")
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = v._data
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux state {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from .ndarray import ndarray as ndmod
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        arg_dict, grad_dict = {}, {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[n]
+            if tuple(old.shape) == tuple(s):
+                arg_dict[n] = old
+                if n in self.grad_dict:
+                    grad_dict[n] = self.grad_dict[n]
+            else:
+                arg_dict[n] = ndmod.zeros(s, ctx=self._ctx,
+                                          dtype=str(old.dtype))
+                if n in self.grad_dict:
+                    grad_dict[n] = ndmod.zeros(s, ctx=self._ctx)
+        aux_dict = {n: (self.aux_dict[n]
+                        if tuple(self.aux_dict[n].shape) == tuple(s)
+                        else ndmod.zeros(s, ctx=self._ctx))
+                    for n, s in zip(self._aux_names, aux_shapes)}
+        return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
+                        dict(self._grad_req), aux_dict)

@@ -1,0 +1,201 @@
+"""Symbol graph IR + executor (parity model: tests/python/unittest/
+test_symbol.py, test_executor.py)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape_fills_weights():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(4, 10))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (3, 8)
+    assert d["softmax_label"] == (4,)
+    assert out_shapes == [(4, 3)]
+
+
+def test_infer_shape_conv_net():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=16, name="c1")
+    b = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(p)
+    fc = sym.FullyConnected(f, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, aux_shapes = fc.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (16, 3, 3, 3)
+    assert d["bn1_gamma"] == (16,)
+    assert d["fc_weight"] == (10, 16 * 3 * 3)
+    assert out_shapes == [(2, 10)]
+    da = dict(zip(fc.list_auxiliary_states(), aux_shapes))
+    assert da["bn1_moving_mean"] == (16,)
+    assert da["bn1_moving_var"] == (16,)
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(mx.cpu(), {"a": nd.array([4.0]), "b": nd.array([2.0])})
+    out = ex.forward()
+    assert np.allclose(out[0].asnumpy(), [(4 + 2) * 2 - 2.0])
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    s1 = sym.sqrt(a, name="s1")
+    s2 = sym.square(a, name="s2")
+    g = sym.Group([s1, s2])
+    assert g.list_outputs() == ["s1_output", "s2_output"]
+    assert g[0].list_outputs() == ["s1_output"]
+    ex = g.bind(mx.cpu(), {"a": nd.array([4.0])})
+    outs = ex.forward()
+    assert np.allclose(outs[0].asnumpy(), [2.0])
+    assert np.allclose(outs[1].asnumpy(), [16.0])
+
+
+def test_multi_output_indexing():
+    a = sym.Variable("a")
+    sp = sym.SliceChannel(a, num_outputs=2, axis=1, name="split")
+    assert sp.list_outputs() == ["split_output0", "split_output1"]
+    ex = sp[1].bind(mx.cpu(), {"a": nd.array([[1.0, 2.0]])})
+    out = ex.forward()
+    assert np.allclose(out[0].asnumpy(), [[2.0]])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert any(n["op"] == "FullyConnected" for n in parsed["nodes"])
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(4, 10))
+    assert out_shapes == [(4, 3)]
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10))
+    # initialize weights
+    rs = np.random.RandomState(0)
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name][:] = rs.normal(0, 0.1, ex.arg_dict[name].shape)
+    ex.arg_dict["data"][:] = rs.rand(4, 10)
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 1])
+    out = ex.forward(is_train=True)[0]
+    assert out.shape == (4, 3)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+    ex.backward()
+    # SoftmaxOutput grad: p - onehot
+    p = out.asnumpy()
+    oh = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    # fc2 bias grad = sum over batch of (p - oh)
+    assert np.allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                       (p - oh).sum(axis=0), rtol=1e-4, atol=1e-5)
+    assert ex.grad_dict["fc1_weight"].shape == (8, 10)
+
+
+def test_grad_req_add_and_null():
+    x = sym.Variable("x")
+    y = sym.sum(sym.square(x), name="loss")
+    ex = y.simple_bind(ctx=mx.cpu(), grad_req="add", x=(3,))
+    ex.arg_dict["x"][:] = [1.0, 2.0, 3.0]
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert np.allclose(ex.grad_dict["x"].asnumpy(), [4.0, 8.0, 12.0])
+    ex2 = y.simple_bind(ctx=mx.cpu(), grad_req="null", x=(3,))
+    ex2.forward(is_train=True)
+    assert ex2.grad_dict == {}
+
+
+def test_batchnorm_aux_update_in_executor():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(8, 4))
+    x = np.random.rand(8, 4).astype(np.float32) * 3
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.forward(is_train=True)
+    assert np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                       0.5 * x.mean(axis=0), rtol=1e-4)
+    # eval forward must NOT update aux
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False)
+    assert np.allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), before)
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    arg_shapes, out_shapes, _ = feat.infer_shape(data=(2, 10))
+    assert out_shapes == [(2, 8)]
+
+
+def test_attr_scope_and_variable_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = sym.FullyConnected(a, num_hidden=2, name="fc")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+    v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_executor_reshape():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10))
+    ex2 = ex.reshape(data=(8, 10))
+    assert ex2.arg_dict["data"].shape == (8, 10)
+    # weights shared (same object)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+    ex2.arg_dict["data"][:] = np.random.rand(8, 10)
+    out = ex2.forward()[0]
+    assert out.shape == (8, 3)
+
+
+def test_monitor_callback():
+    seen = []
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 10))
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=False)
+    assert any("fc1_output" == s for s in seen)
+    assert any("softmax_output" == s for s in seen)
+
+
+def test_variable_compose():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    other = sym.Variable("other")
+    composed = net(data=other)
+    assert "other" in composed.list_arguments()
+    assert "data" not in composed.list_arguments()
