@@ -29,3 +29,10 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+from . import lr_scheduler
+from . import optimizer
+from .optimizer import Optimizer
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import callback

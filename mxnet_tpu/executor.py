@@ -61,7 +61,7 @@ def _build_runner(symbol, is_train):
                 res = (res,)
             n_out = node.num_outputs()
             vals[pos] = res[:n_out]
-            if node.op.mutates_aux and is_train:
+            if node.op.mutates_aux and (is_train or node.op.aux_always):
                 for j, aux_i in enumerate(node.op.aux_indices):
                     n2, _ = node.inputs[aux_i]
                     if id(n2) in aux_index:
@@ -235,7 +235,7 @@ class Executor:
                 out_name = f"{node.name}_output{i if n_out > 1 else ''}" \
                     if n_out > 1 else f"{node.name}_output"
                 self._monitor_callback(out_name, NDArray(res[i]))
-            if node.op.mutates_aux and is_train:
+            if node.op.mutates_aux and (is_train or node.op.aux_always):
                 for j, aux_i in enumerate(node.op.aux_indices):
                     n2, _ = node.inputs[aux_i]
                     if id(n2) in aux_index:

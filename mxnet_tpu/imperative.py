@@ -45,8 +45,15 @@ def jitted_for_schema(schema: OpSchema, attrs, is_train: bool):
     return fn
 
 
-def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None):
-    """Execute an op imperatively on NDArrays; records on the autograd tape."""
+def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
+           ctx=None):
+    """Execute an op imperatively on NDArrays; records on the autograd tape.
+
+    Placement follows MXNet semantics: ops run on their inputs' context;
+    source ops (no array inputs) run on `ctx`/the current context — not
+    jax's default backend — so CPU-context arrays stay on host even on a
+    TPU machine.
+    """
     from . import autograd
     from .ndarray.ndarray import NDArray
     from . import random as _random
@@ -66,16 +73,27 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None):
     if not isinstance(results, tuple):
         results = (results,)
 
+    if n_in == 0:
+        from .context import current_context
+        if out is not None:
+            # out= pins placement: NDArrays never migrate on mutation
+            first_out = out[0] if isinstance(out, (list, tuple)) else out
+            dev = first_out.context.jax_device()
+        else:
+            dev = (ctx or current_context()).jax_device()
+        if any(dev not in r.devices() for r in results):
+            results = tuple(jax.device_put(r, dev) for r in results)
+
     n_out = _num_outputs(schema, attrs)
     outputs = [NDArray(r) for r in results[:n_out]]
 
     # auxiliary-state write-back (BatchNorm moving stats): emulates the
     # reference's in-place aux mutation by rebinding the aux NDArray's buffer
-    if schema.mutates_aux and is_train:
+    if schema.mutates_aux and (is_train or schema.aux_always):
         for j, aux_i in enumerate(schema.aux_indices):
             src = inputs[aux_i]
             if isinstance(src, NDArray):
-                src._data = results[n_out + j]
+                src._rebind(results[n_out + j])
 
     if autograd.is_recording():
         autograd._record(schema, attrs, rng, is_train, inputs, outputs, n_out)
@@ -83,8 +101,7 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None):
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs, outputs):
-            dst._data = src._data
-            dst._ag_node = src._ag_node
+            dst._rebind(src._data, src._ag_node)
         return out
     if len(outputs) == 1:
         return outputs[0]

@@ -1,0 +1,394 @@
+"""Weight initializers.
+
+Parity target: python/mxnet/initializer.py (SURVEY.md §2.4) — `InitDesc` +
+`Initializer` registry with name-pattern dispatch (weight/bias/gamma/beta/
+moving stats), Uniform/Normal/Xavier/MSRAPrelu/Orthogonal/Bilinear/One/Zero/
+Constant/LSTMBias/FusedRNN and the `Mixed` pattern-matcher.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Orthogonal",
+           "Xavier", "MSRAPrelu", "Bilinear", "One", "Zero", "Constant",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to initializers."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+def register(klass):
+    name = klass.__name__.lower()
+    _INIT_REGISTRY[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if name.lower() not in _INIT_REGISTRY:
+        raise MXNetError(f"unknown initializer {name!r}")
+    return _INIT_REGISTRY[name.lower()](**kwargs)
+
+
+class Initializer:
+    """Base initializer; dispatches on parameter-name conventions the way the
+    reference does, honoring per-variable `__init__` attrs."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((np.abs(x.asnumpy()).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info("Initialized %s as %s: %s", desc, init,
+                         self._print_func(arr))
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            self._legacy_init(desc, arr)
+            return
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            self._verbose_print(desc, init, arr)
+        elif desc.endswith("weight"):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, "weight", arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+            self._verbose_print(desc, "bias", arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+            self._verbose_print(desc, "gamma", arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+            self._verbose_print(desc, "beta", arr)
+        elif desc.endswith("min"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("max"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _legacy_init(self, name, arr):
+        if not isinstance(name, str):
+            raise TypeError("name must be string")
+        if not isinstance(arr, NDArray):
+            raise TypeError("arr must be NDArray")
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("weight"):
+            self._init_zero(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("bias"):
+            self._init_loc_bias(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _set(self, arr, value):
+        arr[:] = value
+
+    def _init_bilinear(self, _, arr):
+        weight = np.zeros(np.prod(arr.shape), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.)
+        c = (2 * f - 1 - f % 2) / (2. * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_loc_bias(self, _, arr):
+        assert arr.shape[0] == 6
+        arr[:] = np.array([1.0, 0, 0, 0, 1.0, 0])
+
+    def _init_zero(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_one(self, _, arr):
+        self._set(arr, 1.0)
+
+    def _init_bias(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_gamma(self, _, arr):
+        self._set(arr, 1.0)
+
+    def _init_beta(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_default(self, name, _):
+        raise ValueError(
+            f"Unknown initialization pattern for {name}. Default "
+            "initialization is now limited to \"weight\", \"bias\", "
+            "\"gamma\" (1.0), and \"beta\" (0.0). Please use "
+            "mx.sym.Variable(init=mx.init.*) to set initialization pattern")
+
+
+@register
+class Load:
+    """Initialize from existing param dict, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray.ndarray import load as nd_load
+            param = nd_load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                self.param[name[4:]] = arr
+            else:
+                self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise ValueError(
+                    f"Parameter {name} cannot be initialized from loading. "
+                    f"Shape mismatch, target {arr.shape} vs loaded "
+                    f"{self.param[name].shape}")
+            self.param[name].copyto(arr)
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    f"Cannot Initialize {name}. Not found in loaded param and "
+                    "no default initializer is provided.")
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+@register
+class Mixed:
+    """Pattern-matched initializer list."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            f"Parameter name {name} did not match any pattern. Consider "
+            "adding a \".*\" pattern at the and with default Initializer.")
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        self._set(arr, 0.0)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        self._set(arr, 1.0)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        self._set(arr, self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        from .ndarray import random as ndrandom
+        ndrandom.uniform(-self.scale, self.scale, shape=arr.shape, out=arr)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        from .ndarray import random as ndrandom
+        ndrandom.normal(0, self.sigma, shape=arr.shape, out=arr)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = self.scale * q.reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer cannot be applied to vector {name}. "
+                "It requires at least 2D.")
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            from .ndarray import random as ndrandom
+            ndrandom.uniform(-scale, scale, shape=arr.shape, out=arr)
+        elif self.rnd_type == "gaussian":
+            from .ndarray import random as ndrandom
+            ndrandom.normal(0, scale, shape=arr.shape, out=arr)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2. / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def __init__(self):
+        super().__init__()
+
+    def _init_weight(self, _, arr):
+        Initializer._init_bilinear(self, _, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias except forget gate (set to `forget_bias`)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+
+class FusedRNN(Initializer):
+    """Initialize fused RNN parameter blobs by delegating to an inner
+    initializer per gate (role of reference FusedRNN initializer)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        # flat blob: init whole as weight, then fix LSTM forget-gate biases
+        if self._init is not None:
+            self._init._init_weight(desc, arr)
+        if self._mode == "lstm" and self._forget_bias:
+            pass  # biases are separate arrays in the TPU build's RNN op

@@ -106,6 +106,12 @@ class NDArray:
     def __hash__(self):
         return id(self)
 
+    def __reduce__(self):
+        # picklable via host numpy (role of NDArray binary serialization,
+        # src/ndarray/ndarray.cc:1582; used by Updater.get_states and
+        # DataLoader worker IPC)
+        return (_from_numpy_reduce, (self.asnumpy(),))
+
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]
@@ -134,9 +140,10 @@ class NDArray:
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()))
         if isinstance(other, NDArray):
-            other._data = jax.device_put(self._data, other.context.jax_device())
+            new = jax.device_put(self._data, other.context.jax_device())
             if other.dtype != self.dtype:
-                other._data = other._data.astype(other.dtype)
+                new = new.astype(other.dtype)
+            other._rebind(new)
             return other
         raise TypeError(f"copyto: unsupported target {type(other)}")
 
@@ -403,10 +410,21 @@ class NDArray:
         return self._binary(o, "broadcast_lesser_equal",
                             "_lesser_equal_scalar")
 
+    def _rebind(self, data, ag_node=None):
+        """Rebind the wrapped buffer in-place. A marked variable (AGVar)
+        keeps its marking — mutation outside record() must not unhook a
+        parameter from autograd (MXNet arrays keep their AGInfo across
+        in-place updates); the captured leaf value is refreshed instead."""
+        from .. import autograd
+        self._data = data
+        if isinstance(self._ag_node, autograd.AGVar) and ag_node is None:
+            self._ag_node.value = data
+        else:
+            self._ag_node = ag_node
+
     def _inplace(self, other, op, scalar_op):
         res = self._binary(other, op, scalar_op)
-        self._data = res._data
-        self._ag_node = res._ag_node
+        self._rebind(res._data, res._ag_node)
         return self
 
     def __iadd__(self, o):
@@ -458,12 +476,21 @@ class NDArray:
             v = _np.asarray(value, dtype=self.dtype)[()]
         else:
             v = _np.asarray(value).astype(self.dtype)
+        import jax
+        import jax.numpy as jnp
+        dev = self.context.jax_device()
         if isinstance(key, slice) and key == slice(None):
-            import jax.numpy as jnp
-            self._data = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype),
-                                          self.shape)
+            new = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype),
+                                   self.shape)
         else:
-            self._data = self._data.at[key].set(v)
+            new = self._data.at[key].set(v)
+        # keep the buffer committed to its device: MXNet NDArrays never
+        # migrate on mutation (ndarray.h Chunk ctx is fixed)
+        self._rebind(jax.device_put(new, dev))
+
+
+def _from_numpy_reduce(arr):
+    return array(arr, dtype=arr.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -7,8 +7,8 @@ from .. import imperative as _imp
 
 def _invoke(name, inputs, kwargs):
     out = kwargs.pop("out", None)
-    kwargs.pop("ctx", None)
-    return _imp.invoke(get_op(name), inputs, kwargs, out=out)
+    ctx = kwargs.pop("ctx", None)
+    return _imp.invoke(get_op(name), inputs, kwargs, out=out, ctx=ctx)
 
 
 def _two_form(sampler_name, sample_name, p1, p2):
@@ -20,7 +20,7 @@ def _two_form(sampler_name, sample_name, p1, p2):
                             "dtype": dtype, "out": out})
         return _invoke(sampler_name, [],
                        {p1: a, p2: b, "shape": shape, "dtype": dtype,
-                        "out": out})
+                        "out": out, "ctx": ctx})
     return fn
 
 
@@ -32,31 +32,33 @@ gamma = _two_form("_random_gamma", "_sample_gamma", "alpha", "beta")
 def exponential(scale=1.0, shape=(1,), dtype="float32", ctx=None, out=None, **kw):
     return _invoke("_random_exponential", [],
                    {"lam": 1.0 / scale, "shape": shape, "dtype": dtype,
-                    "out": out})
+                    "out": out, "ctx": ctx})
 
 
 def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None, **kw):
     return _invoke("_random_poisson", [],
-                   {"lam": lam, "shape": shape, "dtype": dtype, "out": out})
+                   {"lam": lam, "shape": shape, "dtype": dtype, "out": out,
+                    "ctx": ctx})
 
 
 def negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None,
                       out=None, **kw):
     return _invoke("_random_negative_binomial", [],
-                   {"k": k, "p": p, "shape": shape, "dtype": dtype, "out": out})
+                   {"k": k, "p": p, "shape": shape, "dtype": dtype, "out": out,
+                    "ctx": ctx})
 
 
 def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
                                   dtype="float32", ctx=None, out=None, **kw):
     return _invoke("_random_generalized_negative_binomial", [],
                    {"mu": mu, "alpha": alpha, "shape": shape, "dtype": dtype,
-                    "out": out})
+                    "out": out, "ctx": ctx})
 
 
 def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None, **kw):
     return _invoke("_random_randint", [],
                    {"low": low, "high": high, "shape": shape, "dtype": dtype,
-                    "out": out})
+                    "out": out, "ctx": ctx})
 
 
 def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):

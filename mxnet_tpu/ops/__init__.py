@@ -3,5 +3,6 @@ from . import registry
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 
 from .registry import get_op, list_ops  # noqa: F401

@@ -1,0 +1,253 @@
+"""Optimizer update operators.
+
+Parity target: src/operator/optimizer_op.{cc,-inl.h} (SURVEY.md §2.2) — the
+reference registers parameter updates as *ops* so they run on-device (and on
+kvstore servers). Here each update is a fused jax function compiled once per
+hyperparameter set: the whole update (rescale, clip, state update, weight
+update) is one XLA executable, so state never round-trips to host and XLA
+fuses it into a couple of HBM passes.
+
+Calling convention (MXNet parity): `mx.nd.sgd_mom_update(w, g, mom, out=w,
+lr=..)` — state inputs are declared aux with `aux_always=True`, so their
+updated values are written back to the passed NDArrays; the new weight is
+output 0 (rebound onto `w` via `out=`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Param, register
+
+__all__ = []
+
+
+def _prep(attrs, grad, weight):
+    """rescale → clip → + wd*weight (SGD-family order: the reference clips
+    the rescaled grad, then applies decay separately)."""
+    g = grad * jnp.asarray(attrs.rescale_grad, grad.dtype)
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        c = jnp.asarray(attrs.clip_gradient, g.dtype)
+        g = jnp.clip(g, -c, c)
+    return g + jnp.asarray(attrs.wd, weight.dtype) * weight
+
+
+def _prep_wd_first(attrs, grad, weight):
+    """rescale → + wd*weight → clip (Adam/RMSProp/FTML-family order: the
+    reference folds decay into the grad before clipping)."""
+    g = grad * jnp.asarray(attrs.rescale_grad, grad.dtype) + \
+        jnp.asarray(attrs.wd, weight.dtype) * weight
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        c = jnp.asarray(attrs.clip_gradient, g.dtype)
+        g = jnp.clip(g, -c, c)
+    return g
+
+
+_COMMON = {
+    "lr": Param("float", required=True),
+    "wd": Param("float", 0.0),
+    "rescale_grad": Param("float", 1.0),
+    "clip_gradient": Param("float", -1.0),
+}
+
+
+def _p(**extra):
+    d = dict(_COMMON)
+    for k, v in extra.items():
+        d[k] = Param("float", v)
+    return d
+
+
+# -- SGD ---------------------------------------------------------------------
+
+def _sgd_update(attrs, octx, weight, grad):
+    g = _prep(attrs, grad, weight)
+    return (weight - jnp.asarray(attrs.lr, weight.dtype) * g,)
+
+
+register("sgd_update", _sgd_update, params=_p(),
+         inputs=("weight", "grad"),
+         # lazy_update only matters for row_sparse grads (dense on TPU)
+         aliases=())
+
+
+def _sgd_mom_update(attrs, octx, weight, grad, mom):
+    g = _prep(attrs, grad, weight)
+    lr = jnp.asarray(attrs.lr, weight.dtype)
+    new_mom = jnp.asarray(attrs.momentum, mom.dtype) * mom - lr * g
+    return (weight + new_mom, new_mom)
+
+
+register("sgd_mom_update", _sgd_mom_update, params=_p(momentum=0.0),
+         inputs=("weight", "grad", "mom"), aux=("mom",),
+         mutates_aux=True, aux_always=True)
+
+
+def _mp_sgd_update(attrs, octx, weight, grad, weight32):
+    g32 = _prep(attrs, grad.astype(jnp.float32), weight32)
+    new_w32 = weight32 - jnp.float32(attrs.lr) * g32
+    return (new_w32.astype(weight.dtype), new_w32)
+
+
+register("mp_sgd_update", _mp_sgd_update, params=_p(),
+         inputs=("weight", "grad", "weight32"), aux=("weight32",),
+         mutates_aux=True, aux_always=True)
+
+
+def _mp_sgd_mom_update(attrs, octx, weight, grad, mom, weight32):
+    g32 = _prep(attrs, grad.astype(jnp.float32), weight32)
+    new_mom = jnp.float32(attrs.momentum) * mom - jnp.float32(attrs.lr) * g32
+    new_w32 = weight32 + new_mom
+    return (new_w32.astype(weight.dtype), new_mom, new_w32)
+
+
+register("mp_sgd_mom_update", _mp_sgd_mom_update, params=_p(momentum=0.0),
+         inputs=("weight", "grad", "mom", "weight32"),
+         aux=("mom", "weight32"), mutates_aux=True, aux_always=True)
+
+
+# -- Adam --------------------------------------------------------------------
+
+def _adam_update(attrs, octx, weight, grad, mean, var):
+    g = _prep_wd_first(attrs, grad, weight)
+    b1 = jnp.asarray(attrs.beta1, mean.dtype)
+    b2 = jnp.asarray(attrs.beta2, var.dtype)
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    step = jnp.asarray(attrs.lr, weight.dtype) * new_mean / (
+        jnp.sqrt(new_var) + jnp.asarray(attrs.epsilon, weight.dtype))
+    return (weight - step, new_mean, new_var)
+
+
+register("adam_update", _adam_update,
+         params=_p(beta1=0.9, beta2=0.999, epsilon=1e-8),
+         inputs=("weight", "grad", "mean", "var"), aux=("mean", "var"),
+         mutates_aux=True, aux_always=True)
+
+
+# -- RMSProp -----------------------------------------------------------------
+
+def _rmsprop_update(attrs, octx, weight, grad, n):
+    g = _prep_wd_first(attrs, grad, weight)
+    g1 = jnp.asarray(attrs.gamma1, n.dtype)
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    step = jnp.asarray(attrs.lr, weight.dtype) * g / jnp.sqrt(
+        new_n + jnp.asarray(attrs.epsilon, weight.dtype))
+    new_w = weight - step
+    if attrs.clip_weights is not None and attrs.clip_weights > 0:
+        cw = jnp.asarray(attrs.clip_weights, weight.dtype)
+        new_w = jnp.clip(new_w, -cw, cw)
+    return (new_w, new_n)
+
+
+register("rmsprop_update", _rmsprop_update,
+         params=_p(gamma1=0.95, epsilon=1e-8, clip_weights=-1.0),
+         inputs=("weight", "grad", "n"), aux=("n",),
+         mutates_aux=True, aux_always=True)
+
+
+def _rmspropalex_update(attrs, octx, weight, grad, n, g_avg, delta):
+    g = _prep_wd_first(attrs, grad, weight)
+    g1 = jnp.asarray(attrs.gamma1, n.dtype)
+    g2 = jnp.asarray(attrs.gamma2, delta.dtype)
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_g = (1 - g1) * g + g1 * g_avg
+    new_delta = g2 * delta - jnp.asarray(attrs.lr, weight.dtype) * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + jnp.asarray(attrs.epsilon, weight.dtype))
+    new_w = weight + new_delta
+    if attrs.clip_weights is not None and attrs.clip_weights > 0:
+        cw = jnp.asarray(attrs.clip_weights, weight.dtype)
+        new_w = jnp.clip(new_w, -cw, cw)
+    return (new_w, new_n, new_g, new_delta)
+
+
+register("rmspropalex_update", _rmspropalex_update,
+         params=_p(gamma1=0.95, gamma2=0.9, epsilon=1e-8, clip_weights=-1.0),
+         inputs=("weight", "grad", "n", "g", "delta"),
+         aux=("n", "g", "delta"), mutates_aux=True, aux_always=True)
+
+
+# -- Ftrl --------------------------------------------------------------------
+
+def _ftrl_update(attrs, octx, weight, grad, z, n):
+    g = grad * jnp.asarray(attrs.rescale_grad, grad.dtype)
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        c = jnp.asarray(attrs.clip_gradient, g.dtype)
+        g = jnp.clip(g, -c, c)
+    lr = jnp.asarray(attrs.lr, weight.dtype)
+    new_z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr * weight
+    new_n = n + jnp.square(g)
+    l1 = jnp.asarray(attrs.lamda1, weight.dtype)
+    beta = jnp.asarray(attrs.beta, weight.dtype)
+    wd = jnp.asarray(attrs.wd, weight.dtype)
+    new_w = jnp.where(
+        jnp.abs(new_z) > l1,
+        (jnp.sign(new_z) * l1 - new_z) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return (new_w, new_z, new_n)
+
+
+register("ftrl_update", _ftrl_update, params=_p(lamda1=0.01, beta=1.0),
+         inputs=("weight", "grad", "z", "n"), aux=("z", "n"),
+         mutates_aux=True, aux_always=True)
+
+
+# -- SignSGD / Signum --------------------------------------------------------
+
+def _signsgd_update(attrs, octx, weight, grad):
+    g = grad * jnp.asarray(attrs.rescale_grad, grad.dtype)
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        c = jnp.asarray(attrs.clip_gradient, g.dtype)
+        g = jnp.clip(g, -c, c)
+    lr = jnp.asarray(attrs.lr, weight.dtype)
+    wd = jnp.asarray(attrs.wd, weight.dtype)
+    return ((1 - lr * wd) * weight - lr * jnp.sign(g),)
+
+
+register("signsgd_update", _signsgd_update, params=_p(),
+         inputs=("weight", "grad"))
+
+
+def _signum_update(attrs, octx, weight, grad, mom):
+    g = grad * jnp.asarray(attrs.rescale_grad, grad.dtype)
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        c = jnp.asarray(attrs.clip_gradient, g.dtype)
+        g = jnp.clip(g, -c, c)
+    lr = jnp.asarray(attrs.lr, weight.dtype)
+    m = jnp.asarray(attrs.momentum, mom.dtype)
+    wd = jnp.asarray(attrs.wd, weight.dtype)
+    new_mom = m * mom - (1 - m) * (g + wd * weight)
+    wd_lh = jnp.asarray(attrs.wd_lh, weight.dtype)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return (new_w, new_mom)
+
+
+register("signum_update", _signum_update, params=_p(momentum=0.0, wd_lh=0.0),
+         inputs=("weight", "grad", "mom"), aux=("mom",),
+         mutates_aux=True, aux_always=True)
+
+
+# -- FTML --------------------------------------------------------------------
+
+def _ftml_update(attrs, octx, weight, grad, d, v, z):
+    g = _prep_wd_first(attrs, grad, weight)
+    t = attrs.t
+    b1 = jnp.asarray(attrs.beta1, v.dtype)
+    b2 = jnp.asarray(attrs.beta2, v.dtype)
+    eps = jnp.asarray(attrs.epsilon, v.dtype)
+    lr = jnp.asarray(attrs.lr, weight.dtype)
+    new_v = b2 * v + (1 - b2) * jnp.square(g)
+    corr2 = 1 - attrs.beta2 ** t
+    corr1 = 1 - attrs.beta1 ** t
+    d_t = jnp.asarray(corr1, v.dtype) / lr * (
+        jnp.sqrt(new_v / jnp.asarray(corr2, v.dtype)) + eps)
+    sigma = d_t - b1 * d
+    new_z = b1 * z + (1 - b1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return (new_w, d_t, new_v, new_z)
+
+
+register("ftml_update", _ftml_update,
+         params={**_p(beta1=0.6, beta2=0.999, epsilon=1e-8),
+                 "t": Param("int", required=True)},
+         inputs=("weight", "grad", "d", "v", "z"), aux=("d", "v", "z"),
+         mutates_aux=True, aux_always=True)

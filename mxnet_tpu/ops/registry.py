@@ -102,6 +102,10 @@ class OpSchema:
     # if True, fcompute returns num_outputs + len(aux_indices) arrays; the
     # trailing ones are updated aux values written back by the caller
     mutates_aux: bool = False
+    # aux writeback normally happens only under is_train (BatchNorm moving
+    # stats); optimizer update ops mutate their state inputs unconditionally
+    # (reference marks them TakeParamAsInput/mutable, optimizer_op.cc)
+    aux_always: bool = False
     needs_rng: bool = False
     # variadic ops (Concat, add_n): attr naming the input count
     key_var_num_args: Optional[str] = None
@@ -146,8 +150,9 @@ _REGISTRY: dict = {}
 
 
 def register(name, fcompute, *, params=None, inputs=("data",), num_outputs=1,
-             aux=(), mutates_aux=False, needs_rng=False, key_var_num_args=None,
-             infer_shape=None, infer_type=None, aliases=()):
+             aux=(), mutates_aux=False, aux_always=False, needs_rng=False,
+             key_var_num_args=None, infer_shape=None, infer_type=None,
+             aliases=()):
     """Register an operator. `aux` is a list of input names that are auxiliary
     states. Returns the OpSchema."""
     params = {k: (v if isinstance(v, Param) else Param(*v) if isinstance(v, tuple)
@@ -157,6 +162,7 @@ def register(name, fcompute, *, params=None, inputs=("data",), num_outputs=1,
     schema = OpSchema(name=name, fcompute=fcompute, params=params,
                       input_names=inputs, num_outputs=num_outputs,
                       aux_indices=aux_idx, mutates_aux=mutates_aux,
+                      aux_always=aux_always,
                       needs_rng=needs_rng, key_var_num_args=key_var_num_args,
                       infer_shape=infer_shape, infer_type=infer_type,
                       aliases=tuple(aliases))

@@ -1,0 +1,145 @@
+"""Metric + initializer tests (reference: tests/python/unittest/test_metric.py
+and initializer coverage inside test_operator/test_module)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_accuracy():
+    m = mx.metric.create("acc") if "acc" in dir(mx.metric) else \
+        mx.metric.Accuracy()
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # both labels within top-2
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 1, 1])
+    m.update([label], [pred])
+    _, f1 = m.get()
+    # tp=2 fp=0 fn=1 → precision 1, recall 2/3 → f1 = 0.8
+    assert abs(f1 - 0.8) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    for name, expect in [("mse", 0.25), ("mae", 0.5), ("rmse", 0.5)]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6, name
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    _, ppl = m.get()
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(ppl - expect) < 1e-5
+
+
+def test_composite_and_create_list():
+    m = mx.metric.create(["accuracy", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    names, _ = m.get()
+    assert "accuracy" in names and "mse" in names
+
+
+def test_custom_metric():
+    def my_metric(label, pred):
+        return float(np.abs(label - pred).sum())
+    m = mx.metric.np(my_metric)
+    m.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.0])])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_loss_metric():
+    m = mx.metric.Loss()
+    m.update(None, [mx.nd.array([1.0, 3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+# -- initializers ------------------------------------------------------------
+
+def test_uniform_normal_constant():
+    arr = mx.nd.zeros((100, 50))
+    mx.init.Uniform(0.1)("fc_weight", arr)
+    a = arr.asnumpy()
+    assert a.min() >= -0.1 and a.max() <= 0.1 and a.std() > 0.01
+    mx.init.Normal(2.0)("fc_weight", arr)
+    assert abs(arr.asnumpy().std() - 2.0) < 0.2
+    mx.init.Constant(3.0)("fc_weight", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 3.0)
+
+
+def test_name_dispatch():
+    init = mx.init.Uniform(0.1)
+    bias = mx.nd.ones((5,))
+    init("fc1_bias", bias)
+    np.testing.assert_allclose(bias.asnumpy(), 0.0)
+    gamma = mx.nd.zeros((5,))
+    init("bn_gamma", gamma)
+    np.testing.assert_allclose(gamma.asnumpy(), 1.0)
+
+
+def test_xavier_scale():
+    arr = mx.nd.zeros((128, 64))
+    mx.init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3)(
+        "w_weight", arr)
+    bound = np.sqrt(3.0 / ((128 + 64) / 2))
+    a = arr.asnumpy()
+    assert a.min() >= -bound - 1e-6 and a.max() <= bound + 1e-6
+
+
+def test_orthogonal():
+    arr = mx.nd.zeros((16, 16))
+    mx.init.Orthogonal(scale=1.0)("q_weight", arr)
+    q = arr.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(16), atol=1e-4)
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*bias", ".*"],
+                         [mx.init.Zero(), mx.init.One()])
+    b = mx.nd.ones((3,))
+    init("conv_bias", b)
+    np.testing.assert_allclose(b.asnumpy(), 0.0)
+    w = mx.nd.zeros((3,))
+    init("conv_weight", w)
+    np.testing.assert_allclose(w.asnumpy(), 1.0)
+
+
+def test_initdesc_attr_init():
+    import json
+    desc = mx.init.InitDesc(
+        "myvar", attrs={"__init__": mx.init.Constant(7.0).dumps()})
+    arr = mx.nd.zeros((4,))
+    mx.init.Uniform()(desc, arr)
+    np.testing.assert_allclose(arr.asnumpy(), 7.0)
+
+
+def test_initializer_dumps_create_roundtrip():
+    s = mx.init.Xavier(magnitude=2.5).dumps()
+    import json
+    name, kwargs = json.loads(s)
+    init2 = mx.init.create(name, **kwargs)
+    assert isinstance(init2, mx.init.Xavier)
+    assert init2.magnitude == 2.5

@@ -1,0 +1,155 @@
+"""Optimizer tests — fused update ops compared against numpy reference
+implementations (the reference's test_optimizer.py strategy, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _np_sgd(w, g, lr, wd=0.0, rescale=1.0, clip=None, mom=None, momentum=0.0):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    g = g + wd * w
+    if mom is None:
+        return w - lr * g, None
+    new_mom = momentum * mom - lr * g
+    return w + new_mom, new_mom
+
+
+def test_sgd_update_op():
+    rng = np.random.RandomState(0)
+    w = rng.uniform(-1, 1, (5, 7)).astype(np.float32)
+    g = rng.uniform(-1, 1, (5, 7)).astype(np.float32)
+    wnd, gnd = mx.nd.array(w), mx.nd.array(g)
+    mx.nd.sgd_update(wnd, gnd, out=wnd, lr=0.1, wd=0.01, rescale_grad=0.5)
+    ref, _ = _np_sgd(w, g, lr=0.1, wd=0.01, rescale=0.5)
+    np.testing.assert_allclose(wnd.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_mom_update_op():
+    rng = np.random.RandomState(1)
+    w = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    g = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    mom = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    wnd, gnd, mnd = mx.nd.array(w), mx.nd.array(g), mx.nd.array(mom)
+    mx.nd.sgd_mom_update(wnd, gnd, mnd, out=wnd, lr=0.05, momentum=0.9,
+                         wd=0.001, rescale_grad=1.0, clip_gradient=0.5)
+    ref_w, ref_m = _np_sgd(w, g, lr=0.05, wd=0.001, clip=0.5, mom=mom,
+                           momentum=0.9)
+    np.testing.assert_allclose(wnd.asnumpy(), ref_w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mnd.asnumpy(), ref_m, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_update_op():
+    rng = np.random.RandomState(2)
+    w = rng.uniform(-1, 1, (6,)).astype(np.float32)
+    g = rng.uniform(-1, 1, (6,)).astype(np.float32)
+    m = np.zeros((6,), np.float32)
+    v = np.zeros((6,), np.float32)
+    wnd, gnd = mx.nd.array(w), mx.nd.array(g)
+    mnd, vnd = mx.nd.array(m), mx.nd.array(v)
+    mx.nd.adam_update(wnd, gnd, mnd, vnd, out=wnd, lr=0.01, beta1=0.9,
+                      beta2=0.999, epsilon=1e-8, wd=0.0)
+    m_ref = 0.9 * m + 0.1 * g
+    v_ref = 0.999 * v + 0.001 * g * g
+    w_ref = w - 0.01 * m_ref / (np.sqrt(v_ref) + 1e-8)
+    np.testing.assert_allclose(wnd.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mnd.asnumpy(), m_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(vnd.asnumpy(), v_ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("ftrl", {}),
+    ("signum", {"learning_rate": 0.01, "momentum": 0.9}),
+    ("ftml", {"learning_rate": 0.01}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("adadelta", {}),
+    ("adamax", {}),
+    ("nadam", {}),
+    ("dcasgd", {"learning_rate": 0.01}),
+])
+def test_optimizer_decreases_quadratic_loss(name, kwargs):
+    """Every optimizer must reduce ||w - target||^2 on a toy problem."""
+    opt = mx.optimizer.create(name, **kwargs)
+    target = mx.nd.array(np.linspace(-1, 1, 12).reshape(3, 4))
+    w = mx.nd.zeros((3, 4))
+    state = opt.create_state(0, w)
+    loss0 = float(((w - target) ** 2).sum().asscalar())
+    for _ in range(30):
+        grad = 2.0 * (w - target)
+        opt.update(0, w, grad, state)
+    loss1 = float(((w - target) ** 2).sum().asscalar())
+    assert loss1 < loss0, (name, loss0, loss1)
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((2, 2))
+    g = mx.nd.ones((2, 2)) * 0.1
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(
+        mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+    np.testing.assert_allclose(
+        upd2.states[0].asnumpy()
+        if not isinstance(upd2.states[0], tuple) else
+        upd2.states[0][0].asnumpy(),
+        upd.states[0].asnumpy()
+        if not isinstance(upd.states[0], tuple) else
+        upd.states[0][0].asnumpy())
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    sched.base_lr = 1.0
+    assert sched(3) == 1.0
+    assert abs(sched(7) - 0.1) < 1e-12
+    assert abs(sched(20) - 0.01) < 1e-12
+
+
+def test_lr_scheduler_poly():
+    sched = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=2.0, pwr=2)
+    assert sched(0) == 2.0
+    assert sched(100) == 0.0
+
+
+def test_optimizer_lr_wd_mult():
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, wd=0.1,
+                              param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    opt.set_lr_mult({"fc_weight": 0.5})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    # bias gets wd_mult 0 by default
+    assert opt._get_wd(1) == 0.0
+    assert abs(opt._get_wd(0) - 0.1) < 1e-12
+
+
+def test_multi_precision_sgd():
+    rng = np.random.RandomState(3)
+    w16 = rng.uniform(-1, 1, (4, 4)).astype(np.float16)
+    g16 = rng.uniform(-1, 1, (4, 4)).astype(np.float16)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    wnd = mx.nd.array(w16, dtype="float16")
+    state = opt.create_state_multi_precision(0, wnd)
+    assert state[1].dtype == np.float32  # master weights
+    opt.update_multi_precision(0, wnd, mx.nd.array(g16, dtype="float16"),
+                               state)
+    assert wnd.dtype == np.float16
