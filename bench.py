@@ -1,0 +1,64 @@
+"""Benchmark: flagship training throughput on the available chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Baseline anchor (BASELINE.md): MXNet LeNet-class convnet throughput; until
+ResNet-50 ImageNet lands, this measures the stage-5 flagship (LeNet/MNIST
+shapes, batch 64) end-to-end training step (fwd+bwd+update) samples/sec.
+vs_baseline is measured/reference where the reference number exists; -1 when
+the reference published no comparable number yet.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    import __graft_entry__ as ge
+
+    sym = ge._lenet_symbol()
+    batch = 64
+    ctx = mx.tpu(0) if mx.context.num_tpus() > 0 else mx.cpu(0)
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(0, 1, size=(512, 1, 28, 28)).astype(np.float32)
+    label = rng.randint(0, 10, size=(512,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=batch)
+
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+
+    batches = list(it)
+
+    def one_epoch():
+        for b in batches:
+            mod.forward_backward(b)
+            mod.update()
+        # drain async work
+        mod._exec.arg_dict[mod._param_names[0]].wait_to_read()
+
+    one_epoch()  # warmup + compile
+    t0 = time.perf_counter()
+    epochs = 5
+    for _ in range(epochs):
+        one_epoch()
+    dt = time.perf_counter() - t0
+    samples_per_sec = epochs * len(batches) * batch / dt
+
+    print(json.dumps({
+        "metric": "lenet_train_throughput",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": -1,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,3 +36,14 @@ from . import initializer
 from . import initializer as init
 from . import metric
 from . import callback
+from . import model
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import monitor
+from .monitor import Monitor
+from . import module
+from . import module as mod
+from . import parallel
+from . import image

@@ -173,13 +173,17 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         from .ndarray.ndarray import NDArray
         from . import random as _random
+        dev = self._ctx.jax_device()
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"forward: unknown argument {k}")
-            self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
-                else jnp.asarray(v)
+            new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            # incoming batch arrays may live on another device (host-side
+            # iterators commit to cpu): the executor owns placement —
+            # this is the reference's kCopyToGPU engine lane
+            self.arg_dict[k]._data = jax.device_put(new, dev)
 
-        rng = _random.next_key()
+        rng = jax.device_put(_random.next_key(), dev)
         if self._monitor_callback is not None:
             return self._forward_monitored(is_train, rng)
         if is_train:

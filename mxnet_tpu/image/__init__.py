@@ -1,0 +1,20 @@
+"""mx.image — image loading and augmentation.
+
+Parity target: python/mxnet/image/ (SURVEY.md §2.4, 2231 LoC: ImageIter +
+augmenter list) and the C++ ImageRecordIter (src/io/iter_image_recordio_2.cc:
+727 — recordio chunks → parallel JPEG decode → augment → batch → prefetch).
+Host-side decode uses cv2/PIL worker threads (the reference's
+`preprocess_threads` OMP pool); the assembled batch crosses to device once.
+"""
+from .image import (imdecode, imresize, imread, resize_short, fixed_crop,
+                    random_crop, center_crop, color_normalize, ImageIter,
+                    CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
+                    RandomCropAug, CenterCropAug, HorizontalFlipAug,
+                    ColorNormalizeAug, CastAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, LightingAug,
+                    RandomGrayAug)
+from .io import ImageRecordIter
+
+__all__ = ["imdecode", "imresize", "imread", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "ImageRecordIter", "Augmenter"]

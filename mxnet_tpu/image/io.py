@@ -1,0 +1,130 @@
+"""ImageRecordIter — C++-iterator-compatible record pipeline.
+
+Parity target: src/io/iter_image_recordio_2.cc:727 (SURVEY.md §3.6): recordio
+chunk read → parallel JPEG decode (`preprocess_threads` thread pool standing
+in for the OMP loop) → augment → batch assembly → background prefetch
+(iter_prefetcher.h double buffering == PrefetchingIter).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import array
+from .. import io as io_mod
+from .. import recordio
+from .image import imdecode, CreateAugmenter
+
+
+class _RawImageRecordIter(io_mod.DataIter):
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, preprocess_threads=4,
+                 label_width=1, data_name="data",
+                 label_name="softmax_label", round_batch=True,
+                 num_parts=1, part_index=0, seed=0, **aug_kwargs):
+        super().__init__(batch_size)
+        self._rec_path = path_imgrec
+        self._idx_path = path_imgidx
+        self._shuffle = shuffle
+        self._label_width = label_width
+        self._round_batch = round_batch
+        self.data_shape = tuple(data_shape)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, preprocess_threads))
+        self._aug = CreateAugmenter(self.data_shape, **{
+            k: v for k, v in aug_kwargs.items()
+            if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                     "mean", "std", "brightness", "contrast", "saturation",
+                     "hue", "pca_noise", "rand_gray", "inter_method")})
+        self._rng = pyrandom.Random(seed)
+
+        if path_imgidx:
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                   "r")
+            seq = list(self._rec.keys)
+        else:
+            # sequential scan to build an in-memory offset-free sequence
+            self._rec = recordio.MXRecordIO(path_imgrec, "r")
+            seq = None
+        if seq is not None and num_parts > 1:
+            part = len(seq) // num_parts
+            seq = seq[part_index * part:(part_index + 1) * part]
+        self._seq = seq
+        self._cur = 0
+
+        c, h, w = self.data_shape
+        self.provide_data = [io_mod.DataDesc(data_name, (batch_size, c, h, w))]
+        self.provide_label = [io_mod.DataDesc(
+            label_name, (batch_size,) if label_width == 1
+            else (batch_size, label_width))]
+        self.reset()
+
+    def reset(self):
+        self._cur = 0
+        if self._seq is not None:
+            if self._shuffle:
+                self._rng.shuffle(self._seq)
+        else:
+            self._rec.reset()
+
+    def _read_raw(self):
+        if self._seq is not None:
+            if self._cur >= len(self._seq):
+                return None
+            s = self._rec.read_idx(self._seq[self._cur])
+            self._cur += 1
+            return s
+        return self._rec.read()
+
+    def _decode_one(self, s):
+        header, img = recordio.unpack(s)
+        img = imdecode(img)
+        for aug in self._aug:
+            img = aug(img)
+        data = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+        label = np.asarray(header.label, dtype=np.float32).reshape(-1)
+        return data, label
+
+    def next(self):
+        raws = []
+        while len(raws) < self.batch_size:
+            s = self._read_raw()
+            if s is None:
+                break
+            raws.append(s)
+        if not raws:
+            raise StopIteration
+        pad = self.batch_size - len(raws)
+        decoded = list(self._pool.map(self._decode_one, raws))
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((self.batch_size, self._label_width),
+                               np.float32)
+        for i, (d, l) in enumerate(decoded):
+            batch_data[i] = d
+            batch_label[i, :len(l)] = l[:self._label_width]
+        if pad and self._round_batch and decoded:
+            for i in range(len(decoded), self.batch_size):
+                d, l = decoded[i % len(decoded)]
+                batch_data[i] = d
+                batch_label[i, :len(l)] = l[:self._label_width]
+        data_nchw = np.transpose(batch_data, (0, 3, 1, 2))
+        label = batch_label[:, 0] if self._label_width == 1 else batch_label
+        return io_mod.DataBatch(data=[array(data_nchw)], label=[array(label)],
+                                pad=pad, provide_data=self.provide_data,
+                                provide_label=self.provide_label)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, prefetch_buffer=2,
+                    **kwargs):
+    """Create the record-image pipeline with background prefetch (matches
+    the C++ iterator's registry-factory usage, io.cc:29)."""
+    inner = _RawImageRecordIter(path_imgrec=path_imgrec,
+                                data_shape=data_shape,
+                                batch_size=batch_size, **kwargs)
+    if prefetch_buffer and int(prefetch_buffer) > 0:
+        return io_mod.PrefetchingIter(inner)
+    return inner

@@ -1,0 +1,199 @@
+"""KVStore — the parameter synchronization facade.
+
+Parity target: python/mxnet/kvstore.py + src/kvstore/ (SURVEY.md §2.3, §3.5).
+The reference has three backends behind one interface: intra-node CommCPU/
+CommDevice reduce, NCCL collectives, and the ps-lite parameter server. On TPU
+all three roles collapse onto XLA: device-local reduce is a jitted add over
+committed buffers, cross-device sync rides ICI collectives (the sharded
+Module/Trainer path fuses psum *into* the step function — this facade is the
+API-compatible veneer for code that drives kvstore explicitly), and multi-host
+sync uses jax.distributed process groups.
+
+Semantics (matching kvstore_local.cc / comm.h):
+  - init(key, value): stores the value; re-init of an existing key errors
+  - push(key, vals): vals (one per device) are summed; if an optimizer was
+    set, the updater applies the merged grad to the stored weight, else the
+    merged value replaces the store
+  - pull(key, outs): broadcast stored value into each out array (device-
+    preserving)
+  - `dist_async` has no ICI analog: accepted, treated as sync, warned once
+    (SURVEY.md §2.3 decision).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctx_key(ctx):
+    return (ctx.device_type, ctx.device_id)
+
+
+class KVStore:
+    """Single-process key-value store with multi-device reduce/broadcast."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}        # str key -> NDArray (canonical copy)
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._residuals = {}    # error-feedback state per key (2bit mode)
+        self._str_key_int = {}  # str key -> stable int for updater indices
+        if "async" in kind:
+            logging.warning(
+                "kvstore %r: async parameter-server mode has no TPU/ICI "
+                "analog; running synchronously (SURVEY.md §2.3)", kind)
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index() if "dist" in self._kind else 0
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count() if "dist" in self._kind else 1
+
+    # -- core ---------------------------------------------------------------
+    @staticmethod
+    def _key_list(key, vals):
+        """Normalize (key, vals) to ([str key], [list-of-NDArray])."""
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        keys = [str(k) for k in keys]
+        if single:
+            vlists = [vals if isinstance(vals, (list, tuple)) else [vals]]
+        else:
+            assert len(vals) == len(keys)
+            vlists = [v if isinstance(v, (list, tuple)) else [v]
+                      for v in vals]
+        return keys, vlists
+
+    def init(self, key, value):
+        keys, vlists = self._key_list(key, value)
+        for k, vlist in zip(keys, vlists):
+            if k in self._store:
+                raise MXNetError(f"key {k!r} already initialized")
+            v = vlist[0]
+            self._str_key_int.setdefault(k, len(self._str_key_int))
+            self._store[k] = v.copy()
+
+    def _reduce(self, vlist):
+        """Sum values living on (possibly) different devices onto the first
+        value's device (role of CommDevice::Reduce, comm.h:451)."""
+        if len(vlist) == 1:
+            return vlist[0].copy()
+        base = vlist[0]
+        acc = base.copy()
+        for v in vlist[1:]:
+            acc += v.as_in_context(base.context)
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys, vlists = self._key_list(key, value)
+        for k, vlist in zip(keys, vlists):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = self._reduce(vlist)
+            if self._compression is not None:
+                merged = self._compress(k, merged)
+            stored = self._store[k]
+            if self._updater is not None:
+                merged = merged.as_in_context(stored.context)
+                self._updater(self._str_key_int[k], merged, stored)
+            else:
+                self._store[k] = merged.as_in_context(stored.context)
+
+    def _compress(self, k, merged):
+        """2-bit stochastic-threshold quantization with error-feedback
+        residual (reference quantize_2bit/dequantize_2bit,
+        src/kvstore/gradient_compression-inl.h:40,97): each element becomes
+        {-threshold, 0, +threshold}; the quantization error accumulates in a
+        residual folded into the next push."""
+        from .ndarray.ndarray import zeros_like
+        threshold = float(self._compression.get("threshold", 0.5))
+        if k not in self._residuals:
+            self._residuals[k] = zeros_like(merged)
+        residual = self._residuals[k]
+        residual += merged
+        quantized = ((residual >= threshold) - (residual <= -threshold)) \
+            * threshold
+        residual -= quantized
+        return quantized
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None, "pull requires out="
+        keys, olists = self._key_list(key, out)
+        for k, olist in zip(keys, olists):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            stored = self._store[k]
+            for o in olist:
+                stored.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull emulated densely (TPU-honest: row_sparse is dense)."""
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer ----------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run `optimizer` inside the store (role of server-side optimizer,
+        kvstore_dist_server.h; here the 'server' is this process)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression parity hook. On TPU grads ride ICI at
+        full precision inside the compiled step; the API records the setting
+        and applies quantize/dequantize error-feedback to explicit pushes."""
+        self._compression = dict(compression_params)
+        if self._compression.get("type", "2bit") != "2bit":
+            raise MXNetError("only 2bit compression is supported")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not initialized"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- distributed --------------------------------------------------------
+    def _barrier(self):
+        if "dist" in self._kind:
+            import jax
+            # all processes join a tiny collective — the TPU-native barrier
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no external servers: optimizer already runs in-process
+
+
+def create(name="local"):
+    """Create a KVStore (kvstore.cc:40 registry)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "device", "nccl", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_async",
+             "dist_device_sync", "dist_sync_device", "dist")
+    if name not in known:
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    return KVStore(name)

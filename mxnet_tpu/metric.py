@@ -187,12 +187,13 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
             pred_label = _as_numpy(pred_label)
-            if pred_label.ndim > _as_numpy(label).ndim or (
-                    pred_label.ndim == _as_numpy(label).ndim and
-                    pred_label.shape != _as_numpy(label).shape):
+            label = _as_numpy(label)
+            if pred_label.ndim > label.ndim or (
+                    pred_label.ndim == label.ndim and
+                    pred_label.shape != label.shape):
                 pred_label = numpy.argmax(pred_label, axis=self.axis)
             pred_label = pred_label.astype("int32")
-            label = _as_numpy(label).astype("int32")
+            label = label.astype("int32")
             check_label_shapes(label.flat, pred_label.flat)
             self.sum_metric += (pred_label.flat == label.flat).sum()
             self.num_inst += len(pred_label.flat)
@@ -486,6 +487,17 @@ class CustomMetric(EvalMetric):
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
+
+
+# short aliases matching the reference registry names
+register(Accuracy, "acc")
+register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
+register(CrossEntropy, "ce", "cross-entropy")
+register(NegativeLogLikelihood, "nll_loss", "nll-loss")
+register(PearsonCorrelation, "pearsonr")
+register(MAE, "mae")
+register(MSE, "mse")
+register(RMSE, "rmse")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

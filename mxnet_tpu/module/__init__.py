@@ -1,0 +1,5 @@
+"""mx.mod — the Module API (parity: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BaseModule", "Module"]

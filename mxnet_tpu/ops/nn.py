@@ -239,19 +239,22 @@ def _pooling(attrs, octx, data):
             extra = max(0, span - (d + 2 * pad[i]))
             pads[2 + i] = (pad[i], pad[i] + extra)
     if ptype == "max":
+        # init must stay a python scalar: a traced-array init defeats jax's
+        # reduce_window monoid recognition and kills reverse-mode autodiff
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
-            jnp.iinfo(data.dtype).min
-        y = jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
+            int(jnp.iinfo(data.dtype).min)
+        y = jax.lax.reduce_window(data, init,
                                   jax.lax.max, window, strides, pads)
     else:
-        y = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype),
+        zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+        y = jax.lax.reduce_window(data, zero,
                                   jax.lax.add, window, strides, pads)
         if ptype == "avg":
             if attrs["count_include_pad"]:
                 y = y / _prod(k)
             else:
                 ones = jnp.ones(data.shape, dtype=data.dtype)
-                cnt = jax.lax.reduce_window(ones, jnp.asarray(0, data.dtype),
+                cnt = jax.lax.reduce_window(ones, zero,
                                             jax.lax.add, window, strides, pads)
                 y = y / cnt
     return _t(y)
@@ -606,7 +609,7 @@ def _lrn(attrs, octx, data):
     sq = jnp.square(data)
     half = n // 2
     pads = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
-    acc = jax.lax.reduce_window(sq, jnp.asarray(0, data.dtype), jax.lax.add,
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
                                 (1, n) + (1,) * (data.ndim - 2),
                                 (1,) * data.ndim, pads)
     return _t(data / jnp.power(knorm + (alpha / n) * acc, beta))

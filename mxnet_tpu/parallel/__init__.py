@@ -1,0 +1,16 @@
+"""mx.parallel — device-mesh parallelism.
+
+TPU-native replacement for the reference's multi-executor data parallelism
+(DataParallelExecutorGroup, python/mxnet/module/executor_group.py:129 +
+kvstore device/NCCL reduce, SURVEY.md §2.3): instead of one executor per
+device with explicit gradient push/pull, the WHOLE training step — forward,
+backward, gradient all-reduce, optimizer update — is one jitted XLA program
+over a `jax.sharding.Mesh`. Batch inputs are sharded along the mesh's data
+axis; parameters are replicated; XLA inserts the psum over ICI where the
+scalar loss sums across the sharded batch. Multi-host: the same program runs
+under jax.distributed with a global mesh (DCN between slices).
+"""
+from .mesh import build_mesh, data_parallel_mesh
+from .dp import DataParallelTrainer
+
+__all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer"]

@@ -1,0 +1,149 @@
+"""Data-parallel training: one compiled step over a device mesh.
+
+Role of the reference stack {DataParallelExecutorGroup → kvstore device/NCCL
+reduce → optimizer update ops} (SURVEY.md §2.3, §3.1-3.5), collapsed into a
+single pjit-sharded XLA program: fwd + bwd + grad-psum + SGD/momentum update.
+Gradient reduction is implicit — the loss sums over the batch axis that is
+sharded across the mesh, so XLA emits the psum over ICI; no push/pull, no
+per-device executor replicas, no host round-trips inside the step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..executor import _build_runner
+
+
+class DataParallelTrainer:
+    """Compile a full training step for a Symbol over a 1-D data mesh.
+
+    Parameters are replicated; `data_names`/`label_names` inputs are sharded
+    on axis 0 over the mesh's `data` axis. The optimizer (sgd / sgd_mom) is
+    fused into the step. This is the engine under Module's multi-context
+    path and the dryrun_multichip driver hook.
+    """
+
+    def __init__(self, symbol, mesh, data_names=("data",),
+                 label_names=("softmax_label",), optimizer="sgd",
+                 learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
+                 loss_index=0):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._symbol = symbol
+        self._mesh = mesh
+        self._data_axis = mesh.axis_names[0]
+        arg_names = symbol.list_arguments()
+        self._arg_names = arg_names
+        self._aux_names = symbol.list_auxiliary_states()
+        input_names = list(data_names) + list(label_names)
+        self._input_names = [n for n in arg_names if n in input_names]
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._param_pos = [arg_names.index(n) for n in self._param_names]
+        self._input_pos = [arg_names.index(n) for n in self._input_names]
+        self._lr = float(learning_rate)
+        self._momentum = float(momentum)
+        self._wd = float(wd)
+        self._rescale = rescale_grad
+        self._loss_index = loss_index
+        if optimizer not in ("sgd",):
+            raise MXNetError(
+                f"DataParallelTrainer: fused optimizer {optimizer!r} not "
+                "supported (sgd/sgd-momentum); use Module+kvstore instead")
+
+        run = _build_runner(symbol, is_train=True)
+        n_args = len(arg_names)
+        param_pos = list(self._param_pos)
+        input_pos = list(self._input_pos)
+        lr, mom, wd = self._lr, self._momentum, self._wd
+        rescale = self._rescale
+        loss_index = self._loss_index
+
+        def step(params, momenta, aux, inputs, rng):
+            def loss_fn(params):
+                args = [None] * n_args
+                for p, v in zip(param_pos, params):
+                    args[p] = v
+                for p, v in zip(input_pos, inputs):
+                    args[p] = v
+                outputs, new_aux = run(tuple(args), aux, rng)
+                # summing the (custom-vjp) head over the sharded batch is
+                # what makes XLA insert the gradient psum over ICI
+                loss = outputs[loss_index].sum()
+                return loss, (new_aux, outputs)
+
+            (loss, (new_aux, outputs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            scale = rescale if rescale is not None else 1.0
+            new_params, new_momenta = [], []
+            for w, g, m in zip(params, grads, momenta):
+                g = g * jnp.asarray(scale, g.dtype) + \
+                    jnp.asarray(wd, w.dtype) * w
+                if mom != 0.0:
+                    m = jnp.asarray(mom, m.dtype) * m - \
+                        jnp.asarray(lr, w.dtype) * g
+                    w = w + m
+                else:
+                    w = w - jnp.asarray(lr, w.dtype) * g
+                new_params.append(w)
+                new_momenta.append(m)
+            return (tuple(new_params), tuple(new_momenta), new_aux, loss,
+                    outputs)
+
+        repl = NamedSharding(mesh, P())
+        shard = NamedSharding(mesh, P(self._data_axis))
+        self._repl, self._shard = repl, shard
+        self._step = jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, shard, repl),
+            out_shardings=(repl, repl, repl, repl, shard),
+            donate_argnums=(0, 1))
+
+    @property
+    def param_names(self):
+        return list(self._param_names)
+
+    @property
+    def input_names(self):
+        return list(self._input_names)
+
+    def init_state(self, shape_kwargs, initializer=None, seed=0):
+        """Infer shapes from input shapes; return (params, momenta, aux)
+        tuples of replicated jax arrays."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        shapes = dict(zip(self._arg_names, arg_shapes))
+        rng = _np.random.RandomState(seed)
+        params = []
+        for n in self._param_names:
+            s = shapes[n]
+            if initializer is not None:
+                from ..ndarray.ndarray import zeros as nd_zeros
+                arr = nd_zeros(s)
+                from ..initializer import InitDesc
+                initializer(InitDesc(n), arr)
+                v = arr._data
+            else:
+                v = jnp.asarray(
+                    rng.normal(0, 0.01, size=s).astype(_np.float32))
+            params.append(jax.device_put(v, self._repl))
+        momenta = tuple(jax.device_put(jnp.zeros_like(p), self._repl)
+                        for p in params)
+        aux = tuple(jax.device_put(
+            # moving variances start at 1 (MXNet BatchNorm aux parity)
+            jnp.ones(s, _np.float32) if n.endswith("moving_var")
+            else jnp.zeros(s, _np.float32), self._repl)
+            for n, s in zip(self._aux_names, aux_shapes))
+        return tuple(params), momenta, aux
+
+    def shard_inputs(self, arrays):
+        """Commit host batch arrays to the mesh, sharded on axis 0."""
+        return tuple(jax.device_put(jnp.asarray(a), self._shard)
+                     for a in arrays)
+
+    def step(self, params, momenta, aux, inputs, rng=None):
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key()
+        return self._step(params, momenta, aux, inputs, rng)

@@ -1,0 +1,36 @@
+"""Mesh helpers: build jax device meshes for dp/tp/pp axes."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_mesh(axis_sizes: dict, devices=None):
+    """Build a Mesh with named axes, e.g. {'data': 4, 'model': 2}.
+
+    Axis order follows dict order; total size must divide the device count.
+    This is the TPU-native analog of choosing ctx=[gpu(0)..gpu(n)] — the mesh
+    IS the device list, and shardings replace per-device executor replicas.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(axis_sizes[n]) for n in names)
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {axis_sizes} needs {total} devices, have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(n=None, devices=None):
+    """1-D data-parallel mesh over n (default: all) devices."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    if n is None:
+        n = len(devices)
+    return build_mesh({"data": n}, devices)

@@ -1,0 +1,221 @@
+"""RecordIO — binary record files.
+
+Parity target: python/mxnet/recordio.py + dmlc-core's recordio format
+(SURVEY.md §2.4; the dmlc submodule is re-implemented here in pure python,
+format-compatible: magic 0xced7230a, uint32 length word with 3-bit
+continuation flag, 4-byte alignment). MXIndexedRecordIO adds the .idx
+seek table; pack/unpack carry the IRHeader (flag, label, id, id2) used by
+im2rec-produced datasets.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fp = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        fp = d.pop("fp", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d["is_open"]
+        self.is_open = False
+        self.fp = None
+        if is_open:
+            self.open()
+
+    def close(self):
+        if self.is_open and self.fp is not None:
+            self.fp.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self.fp.write(struct.pack("<I", _kMagic))
+        self.fp.write(struct.pack("<I", len(buf)))
+        self.fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self.fp.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _kMagic:
+            raise IOError("Invalid RecordIO magic number")
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+    def tell(self):
+        return self.fp.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via a text .idx seek table
+    (recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into a record string. Multi-label uses
+    flag = label count and prepends float32 labels."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=np.frombuffer(s[:header.flag * 4], dtype=np.float32))
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array; requires cv2 or PIL for encoding."""
+    encoded = _encode_img(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, decoded image array)."""
+    header, s = unpack(s)
+    img = _decode_img(s, iscolor)
+    return header, img
+
+
+def _encode_img(img, quality, img_fmt):
+    try:
+        import cv2
+        flag = (cv2.IMWRITE_JPEG_QUALITY
+                if img_fmt.lower() in (".jpg", ".jpeg")
+                else cv2.IMWRITE_PNG_COMPRESSION)
+        ret, buf = cv2.imencode(img_fmt, img, [flag, quality])
+        assert ret, "failed to encode image"
+        return buf.tobytes()
+    except ImportError:
+        pass
+    import io as _io
+    from PIL import Image
+    pil = Image.fromarray(np.asarray(img).astype(np.uint8))
+    bio = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(bio, format=fmt, quality=quality)
+    return bio.getvalue()
+
+
+def _decode_img(s, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    except ImportError:
+        pass
+    import io as _io
+    from PIL import Image
+    return np.asarray(Image.open(_io.BytesIO(s)))

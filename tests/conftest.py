@@ -1,10 +1,16 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding paths are exercised without TPU hardware (the driver validates the
-real multi-chip path via __graft_entry__.dryrun_multichip)."""
+real multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment's axon site hook sets jax config `jax_platforms=
+"axon,cpu"` at interpreter start, which overrides JAX_PLATFORMS env — so we
+must override via jax.config here, before any backend is initialized.
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_platforms", "cpu")

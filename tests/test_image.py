@@ -1,0 +1,133 @@
+"""Image package + ImageRecordIter tests (reference: tests/python/unittest/
+test_image.py + io record pipeline)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def _make_rec(tmp_path, n=12, size=(16, 16)):
+    rec_path = str(tmp_path / "img.rec")
+    idx_path = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.uniform(0, 255, size=size + (3,))).astype(np.uint8)
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(hdr, img, quality=90, img_fmt=".png"))
+    w.close()
+    return rec_path, idx_path
+
+
+def test_imdecode_imresize():
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, size=(20, 30, 3)).astype(np.uint8)
+    buf = recordio.pack_img(recordio.IRHeader(0, 0, 0, 0), img,
+                            img_fmt=".png")
+    _, decoded = recordio.unpack_img(buf)
+    np.testing.assert_allclose(decoded[..., ::-1] if decoded.shape[-1] == 3
+                               else decoded, img[..., ::-1]
+                               if decoded.shape[-1] == 3 else img)
+    nd_img = mx.image.imdecode(recordio.unpack(buf)[1])
+    assert nd_img.shape == (20, 30, 3)
+    resized = mx.image.imresize(nd_img, 15, 10)
+    assert resized.shape == (10, 15, 3)
+
+
+def test_crops_and_normalize():
+    img = mx.nd.array(np.arange(20 * 20 * 3).reshape(20, 20, 3) % 255,
+                      dtype="uint8")
+    c, _ = mx.image.center_crop(img, (8, 8))
+    assert c.shape == (8, 8, 3)
+    r, roi = mx.image.random_crop(img, (8, 8))
+    assert r.shape == (8, 8, 3)
+    norm = mx.image.color_normalize(c.astype("float32"),
+                                    mean=np.array([1.0, 2.0, 3.0]))
+    assert norm.dtype == np.float32
+
+
+def test_augmenter_list():
+    augs = mx.image.CreateAugmenter(data_shape=(3, 8, 8), rand_mirror=True,
+                                    mean=True, std=True, brightness=0.1)
+    img = mx.nd.array(np.random.uniform(0, 255, (12, 12, 3)), dtype="uint8")
+    out = img
+    for aug in augs:
+        out = aug(out)
+    assert out.shape == (8, 8, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_record_iter(tmp_path):
+    rec, idx = _make_rec(tmp_path, n=10)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                               data_shape=(3, 8, 8), batch_size=4,
+                               shuffle=True, preprocess_threads=2,
+                               prefetch_buffer=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    assert batches[0].label[0].shape == (4,)
+    # last batch padded
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_no_idx(tmp_path):
+    rec, _ = _make_rec(tmp_path, n=6)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 8, 8),
+                               batch_size=3, prefetch_buffer=0)
+    batches = list(it)
+    assert len(batches) == 2
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert sorted(labels.tolist()) == [0.0, 0.0, 1.0, 1.0, 2.0, 2.0]
+
+
+def test_image_iter_imglist(tmp_path):
+    # write a couple of pngs to disk
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(4):
+        arr = rng.randint(0, 255, size=(10, 10, 3)).astype(np.uint8)
+        f = str(tmp_path / f"im{i}.png")
+        Image.fromarray(arr).save(f)
+        files.append([float(i), f"im{i}.png"])
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                            imglist=files, path_root=str(tmp_path))
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 8, 8)
+
+
+def test_kvstore_2bit_compression():
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(9, mx.nd.zeros((4,)))
+    kv.push(9, mx.nd.array([1.0, 0.3, -0.7, 0.0]))
+    out = mx.nd.empty((4,))
+    kv.pull(9, out=out)
+    # quantized: [0.5, 0, -0.5, 0]
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+    # error feedback: residual [0.5, 0.3, -0.2, 0] folds into next push
+    kv.push(9, mx.nd.array([0.0, 0.3, 0.0, 0.0]))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5, 0.0, 0.0])
+
+
+def test_batchnorm_module_init():
+    """BN aux states initialize through Module (regression: InitDesc path
+    must dispatch moving_mean/moving_var)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(mx.sym.FullyConnected(data, num_hidden=8,
+                                                 name="fc"), name="bn")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=3,
+                                                     name="fc2"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    _, aux = mod.get_params()
+    np.testing.assert_allclose(aux["bn_moving_var"].asnumpy(), 1.0)
+    np.testing.assert_allclose(aux["bn_moving_mean"].asnumpy(), 0.0)

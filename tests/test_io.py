@@ -1,0 +1,138 @@
+"""Data iterator + recordio tests (reference: tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), label[:5])
+    assert batches[0].pad == 0
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = mx.io.NDArrayIter(data, None, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    it = mx.io.NDArrayIter(data, None, batch_size=5,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_shuffle_dict():
+    data = {"a": np.arange(40).reshape(20, 2), "b": np.arange(20).reshape(20, 1)}
+    label = np.arange(20)
+    it = mx.io.NDArrayIter(data, label, batch_size=4, shuffle=True)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    got = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(got.tolist()) == sorted(label.tolist())
+
+
+def test_resize_iter():
+    data = np.zeros((10, 3), np.float32)
+    base = mx.io.NDArrayIter(data, None, batch_size=5)
+    it = mx.io.ResizeIter(base, size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(60).reshape(20, 3).astype(np.float32)
+    label = np.arange(20).astype(np.float32)
+    base = mx.io.NDArrayIter(data, label, batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    count = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3)
+        count += 1
+    assert count == 5
+    it.reset()
+    assert len([1 for _ in it]) == 5
+
+
+def test_mnist_iter_synthetic():
+    it = mx.io.MNISTIter(image="/nonexistent/train-images", batch_size=32,
+                         silent=True, synthetic_size=256)
+    batch = next(it)
+    assert batch.data[0].shape == (32, 1, 28, 28)
+    assert batch.label[0].shape == (32,)
+    x = batch.data[0].asnumpy()
+    assert x.min() >= 0 and x.max() <= 1
+    it_flat = mx.io.MNISTIter(image="/nonexistent/train-images",
+                              batch_size=32, flat=True, silent=True,
+                              synthetic_size=256)
+    assert next(it_flat).data[0].shape == (32, 784)
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.uniform(size=(11, 4)).astype(np.float32)
+    label = np.arange(11).astype(np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(4,), label_csv=lpath,
+                       batch_size=3)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:3], rtol=1e-5)
+    np.testing.assert_allclose(b.label[0].asnumpy(), label[:3])
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = mx.recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = mx.recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record-{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = mx.recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert r.keys == [0, 1, 2, 3, 4]
+    r.close()
+
+
+def test_pack_unpack():
+    hdr = mx.recordio.IRHeader(0, 3.0, 42, 0)
+    s = mx.recordio.pack(hdr, b"payload")
+    hdr2, payload = mx.recordio.unpack(s)
+    assert payload == b"payload"
+    assert hdr2.label == 3.0 and hdr2.id == 42
+    # multi-label
+    hdr = mx.recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 7, 0)
+    s = mx.recordio.pack(hdr, b"x")
+    hdr2, payload = mx.recordio.unpack(s)
+    np.testing.assert_allclose(hdr2.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+def test_databatch_desc():
+    d = mx.io.DataDesc("data", (32, 3, 224, 224))
+    assert d.name == "data" and d.shape == (32, 3, 224, 224)
+    assert mx.io.DataDesc.get_batch_axis("NCHW") == 0
+    assert mx.io.DataDesc.get_batch_axis("TNC") == 1

@@ -1,0 +1,111 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py —
+single-process multi-device reduce correctness)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kind="local"):
+    kv = mx.kvstore.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    np.testing.assert_allclose(A.asnumpy(), np.full(A.shape, x), rtol=1e-5)
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 4)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    val = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator_multi_device():
+    """Push a list of per-device values for one key → pull the sum."""
+    kv = init_kv("device")
+    num_devs = 4
+    devs = [mx.cpu(0)] * num_devs
+    vals = [mx.nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = [mx.nd.empty(SHAPE, ctx=d) for d in devs]
+    kv.pull(3, out=out)
+    for o in out:
+        check_diff_to_scalar(o, num_devs)
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 2)
+
+
+def test_set_optimizer_sgd():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    # stored weight starts at 0; push grad of ones → w = -0.1
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, -0.1)
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push(3, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    # two momentum sgd steps: v1=-0.1, w1=-0.1; v2=0.9*(-0.1)-0.1=-0.19, w2=-0.29
+    check_diff_to_scalar(val, -0.29)
+
+
+def test_init_twice_errors():
+    kv = init_kv()
+    with pytest.raises(mx.MXNetError):
+        kv.init(3, mx.nd.ones(SHAPE))
+
+
+def test_push_uninitialized_errors():
+    kv = mx.kvstore.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push(99, mx.nd.ones(SHAPE))
+
+
+def test_unknown_kind_errors():
+    with pytest.raises(mx.MXNetError):
+        mx.kvstore.create("bogus")
+
+
+def test_rank_and_type():
+    kv = mx.kvstore.create("device")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    assert kv.type == "device"
