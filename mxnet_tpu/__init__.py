@@ -47,3 +47,4 @@ from . import module
 from . import module as mod
 from . import parallel
 from . import image
+from . import gluon

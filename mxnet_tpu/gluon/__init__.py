@@ -1,0 +1,15 @@
+"""mx.gluon — the imperative high-level API (parity: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import rnn
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "data", "utils",
+           "model_zoo", "rnn"]

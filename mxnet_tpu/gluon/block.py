@@ -1,0 +1,551 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Parity target: python/mxnet/gluon/block.py (SURVEY.md §2.4, §3.2). `Block`
+is the imperative container; `HybridBlock.hybridize()` swaps eager per-op
+dispatch for a cached whole-graph program: the reference traces
+hybrid_forward with Symbols and runs a CachedOp (block.py:480,513 →
+cached_op.cc:372); here the traced Symbol lowers through the same runner the
+Executor uses — ONE jitted XLA module per input signature, with autograd
+recording the fused program as a single tape entry.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as ndmod
+from .. import symbol as symmod
+from ..symbol import Symbol
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _flatten_args(args):
+    """Flatten nested list/tuple args into leaves + structure descriptor
+    (role of block.py _flatten/_regroup: hybridized calls may pass state
+    lists, e.g. lstm(x, [h, c]))."""
+    flat = []
+
+    def rec(a):
+        if isinstance(a, (list, tuple)):
+            return tuple(rec(x) for x in a)
+        flat.append(a)
+        return len(flat) - 1
+
+    fmt = tuple(rec(a) for a in args)
+    return flat, fmt
+
+
+def _regroup_args(flat, fmt):
+    def rec(f):
+        if isinstance(f, tuple):
+            return [rec(x) for x in f]
+        return flat[f]
+    return [rec(f) for f in fmt]
+
+
+class _BlockScope:
+    """Name-manager for automatic prefixes (block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..base import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (block.py:124)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(f"  ({key}): {_indent(repr(block), 2)}"
+                           for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr) \
+            if modstr else f"{self.__class__.__name__}()"
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    f"Changing attribute type for {self.name} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                f"Overriding Parameter attribute {name} is not allowed. " \
+                "If you want to share parameters between blocks, please " \
+                "set 'params' at Block construction instead."
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this block and children, optionally filtered by
+        regex `select` (block.py collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename):
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._reduce() if hasattr(val, "_reduce")
+                    else val.data() for key, val in params.items()}
+        ndmod.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        loaded = ndmod.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in i for i in loaded.keys()):
+            # legacy loading: collect_params().load
+            del loaded
+            self.collect_params().load(filename, ctx, allow_missing,
+                                       ignore_extra, self.prefix)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    (f"Parameter '{name}' is missing in file '{filename}'")
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    f"Parameter '{name}' loaded from file '{filename}' is "
+                    "not present in ParameterDict")
+            if name in params:
+                params[name]._load_init(loaded[name], ctx)
+
+    # keep the older API names working (reference deprecates but keeps them)
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            from .. import initializer
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        raise NotImplementedError("summary: pending")
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line
+                                    for line in lines)
+
+
+class HybridBlock(Block):
+    """Block with symbolic tracing support (block.py:429)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = ()
+        self._cached_run = {}
+        self._cached_fmt = None
+        self._out_fmt = None
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but "
+                f"{block!r} has type {type(block)}. If you are using "
+                "Sequential, please try HybridSequential instead.")
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_run = {}
+        self._cached_fmt = None
+        self._out_fmt = None
+
+    def _get_graph(self, *args):
+        """Trace hybrid_forward with Symbols (block.py _get_graph). Nested
+        list args (RNN states) are flattened to data{i} variables and
+        regrouped for the trace; the arg structure is part of the cache
+        contract — a different structure on a later call errors instead of
+        silently reusing a mismatched graph."""
+        flat_args, fmt = _flatten_args(args)
+        if self._cached_graph:
+            if self._cached_fmt != fmt:
+                raise ValueError(
+                    f"Hybridized {self.name}: call argument structure "
+                    f"{fmt} does not match the structure it was first "
+                    f"traced with {self._cached_fmt}. Call hybridize() "
+                    "again to re-trace.")
+            return self._cached_graph
+        inputs = [symmod.var(f"data{i}") for i in range(len(flat_args))] \
+            if len(flat_args) > 1 else [symmod.var("data")]
+        grouped = _regroup_args(inputs, fmt)
+        params = {name: param.var()
+                  for name, param in self._reg_params.items()}
+        with self.name_scope():
+            out = self.hybrid_forward(symmod, *grouped, **params)
+        flat_out, out_fmt = _flatten_args(
+            out if isinstance(out, (list, tuple)) else (out,))
+        self._out_fmt = out_fmt if isinstance(out, (list, tuple)) else None
+        if isinstance(out, (list, tuple)):
+            out = symmod.Group(list(flat_out))
+        self._cached_graph = (inputs, out)
+        self._cached_fmt = fmt
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        """Infer (and set) param shapes from input shapes."""
+        inputs, out = self._get_graph(*args)
+        arg_shapes, _, aux_shapes = out.infer_shape_partial(
+            **{inp.name: arg.shape for inp, arg in zip(inputs, args)})
+        names = out.list_arguments() + out.list_auxiliary_states()
+        shapes = dict(zip(out.list_arguments(), arg_shapes))
+        shapes.update(dict(zip(out.list_auxiliary_states(), aux_shapes)))
+        for _, param in self.collect_params().items():
+            if param.name in shapes and shapes[param.name] is not None:
+                param.shape = tuple(shapes[param.name])
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred. " + str(e)) from e
+
+    def _call_cached_graph(self, *args):
+        """Execute the traced graph as one compiled program with autograd
+        recording (role of CachedOp::Forward, cached_op.cc:372)."""
+        import jax
+        from .. import autograd
+        from .. import imperative as _imp
+        from .. import random as _random
+        from ..executor import _build_runner
+
+        flat_args, _ = _flatten_args(args)
+        inputs, out = self._get_graph(*args)
+        args_n, aux_n = out._input_vars()
+        param_map = {p.name: p for _, p in self.collect_params().items()}
+        input_map = {inp.name: a for inp, a in zip(inputs, flat_args)}
+
+        arg_arrays = []
+        for n in args_n:
+            if n.name in input_map:
+                arg_arrays.append(input_map[n.name])
+            else:
+                arg_arrays.append(param_map[n.name].data(
+                    _first_ctx(args)))
+        aux_arrays = [param_map[n.name].data(_first_ctx(args))
+                      for n in aux_n]
+
+        is_train = autograd.is_training()
+        key = (id(out), is_train,
+               tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays))
+        run = self._cached_run.get(key)
+        if run is None:
+            base = _build_runner(out, is_train)
+            n_args = len(arg_arrays)
+
+            def flat(*arrays):
+                rng = arrays[-1]
+                arg_v = arrays[:n_args]
+                aux_v = arrays[n_args:-1]
+                outputs, new_aux = base(arg_v, aux_v, rng)
+                return tuple(outputs) + tuple(new_aux)
+            run = jax.jit(flat)
+            self._cached_run[key] = run
+
+        rng = _random.next_key()
+        datas = [a._data for a in arg_arrays] + \
+                [a._data for a in aux_arrays] + [rng]
+        results = run(*datas)
+        n_out = out.num_outputs
+        outputs = [NDArray(r) for r in results[:n_out]]
+        # aux writeback (BatchNorm moving stats) outside the tape
+        for arr, new in zip(aux_arrays, results[n_out:]):
+            arr._rebind(new)
+        if autograd.is_recording():
+            autograd._record_fn(
+                lambda *arrays, _r=run, _rng=rng:
+                    _r(*arrays, _rng)[:n_out],
+                arg_arrays + aux_arrays, outputs, n_out=n_out)
+        if self._out_fmt is not None:
+            regrouped = _regroup_args(outputs, self._out_fmt)
+            return tuple(regrouped) if len(regrouped) > 1 else regrouped[0]
+        if len(outputs) == 1:
+            return outputs[0]
+        return tuple(outputs)
+
+    def __call__(self, *args):
+        return super().__call__(*args)
+
+    def forward(self, x, *args):
+        """Dispatch: hybridized → cached graph; else eager hybrid_forward
+        with NDArray params (block.py HybridBlock.forward)."""
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_graph(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for _, p in self.collect_params().items():
+                        p._finish_deferred_init()
+                    return self._call_cached_graph(x, *args)
+            try:
+                params = {k: v.data(x.context)
+                          for k, v in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, p in self.collect_params().items():
+                    p._finish_deferred_init()
+                params = {k: v.data(x.context)
+                          for k, v in self._reg_params.items()}
+            return self.hybrid_forward(ndmod, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            f"HybridBlock requires the first argument to forward be either " \
+            f"Symbol or NDArray, but got {type(x)}"
+        params = {k: v.var() for k, v in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(symmod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export symbol json + params for Module/C-predict consumption."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save(f"{path}-symbol.json")
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for _, param in self.collect_params().items():
+            if param.name in arg_names:
+                arg_dict[f"arg:{param.name}"] = param.data()
+            elif param.name in aux_names:
+                arg_dict[f"aux:{param.name}"] = param.data()
+        ndmod.save("%s-%04d.params" % (path, epoch), arg_dict)
+
+
+def _first_ctx(args):
+    for a in args:
+        if isinstance(a, NDArray):
+            return a.context
+    return current_context()
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a callable HybridBlock (block.py:665)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = symmod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [symmod.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.collect_params().load(param_file, ctx=ctx)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        if isinstance(inputs, (Symbol,)) and len(inputs) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = symmod.Group(list(outputs))
+        syms = inputs if isinstance(inputs, list) else [inputs]
+        input_names = {s.name for s in syms}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True,
+                                grad_req="null")
+        self._cached_graph = (syms, outputs)
+        self._cached_fmt = tuple(range(len(syms)))
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            try:
+                return self._call_cached_graph(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, p in self.collect_params().items():
+                    p._finish_deferred_init()
+                return self._call_cached_graph(x, *args)
+        assert isinstance(x, Symbol)
+        ret = copy.copy(self._cached_graph[1])
+        ret._compose(**{self._cached_graph[0][i].name: v
+                        for i, v in enumerate([x] + list(args))})
+        return ret
+
+    def _clear_cached_op(self):
+        tmp = self._cached_graph
+        tmp_fmt = getattr(self, "_cached_fmt", None)
+        super()._clear_cached_op()
+        self._cached_graph = tmp
+        self._cached_fmt = tmp_fmt
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,10 @@
+"""gluon.data — Dataset / Sampler / DataLoader (parity:
+python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .dataloader import DataLoader
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "DataLoader", "vision"]

@@ -1,0 +1,94 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes that ship NDArrays through POSIX
+shared memory (dataloader.py:23-86 + cpu_shared storage, storage.cc:96).
+Here batchification runs in a thread pool: decode/augment is numpy (GIL
+released in cv2/np), and the assembled batch makes exactly one host→device
+transfer — the multiprocessing+shm dance exists to feed GPUs from python
+workers, whereas the TPU input bottleneck is the single host→HBM copy.
+`num_workers>0` selects the threaded path; 0 runs inline.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack sample tuples into batch arrays."""
+    if isinstance(data[0], NDArray):
+        import numpy as _np
+        return array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn if batchify_fn is not None \
+            else default_batchify_fn
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._num_workers) if self._num_workers else None
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx]
+                                         for idx in batch])
+            return
+
+        def fetch(batch):
+            return self._batchify_fn([self._dataset[idx] for idx in batch])
+
+        # pipeline: keep 2*workers batches in flight
+        batches = iter(self._batch_sampler)
+        futures = []
+        try:
+            for _ in range(2 * self._num_workers):
+                futures.append(self._pool.submit(fetch, next(batches)))
+        except StopIteration:
+            pass
+        while futures:
+            out = futures.pop(0).result()
+            try:
+                futures.append(self._pool.submit(fetch, next(batches)))
+            except StopIteration:
+                pass
+            yield out
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,7 @@
+"""gluon.data.vision — datasets + transforms."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, \
+    ImageRecordDataset, ImageFolderDataset
+from . import transforms
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "transforms"]

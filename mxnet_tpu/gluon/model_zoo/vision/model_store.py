@@ -1,0 +1,41 @@
+"""Pretrained model store (parity: gluon/model_zoo/model_store.py).
+
+Zero-egress: pretrained weights load from MXNET_HOME/models (or
+~/.mxnet/models) if present; there is no network download path.
+"""
+from __future__ import annotations
+
+import os
+
+from ....base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=None):
+    root = os.path.expanduser(root or os.environ.get(
+        "MXNET_HOME", os.path.join("~", ".mxnet")))
+    if not root.endswith("models"):
+        root = os.path.join(root, "models")
+    for fname in (os.path.join(root, f"{name}.params"),):
+        if os.path.exists(fname):
+            return fname
+    # epoch-suffixed files
+    if os.path.isdir(root):
+        cands = sorted(f for f in os.listdir(root)
+                       if f.startswith(name + "-") and
+                       f.endswith(".params"))
+        if cands:
+            return os.path.join(root, cands[-1])
+    raise MXNetError(
+        f"Pretrained model file for {name!r} not found under {root}. "
+        "This environment has no network egress — place the .params file "
+        "there manually, or use pretrained=False.")
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,7 @@
+"""gluon.nn — neural network layers."""
+from .basic_layers import *
+from .conv_layers import *
+from . import basic_layers
+from . import conv_layers
+
+__all__ = basic_layers.__all__ + conv_layers.__all__

@@ -1,0 +1,461 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity target: python/mxnet/gluon/parameter.py (807 LoC; SURVEY.md §2.4):
+deferred shape inference, grad_req, per-context data copies, initialize/
+reset_ctx/zero_grad, ParameterDict with prefix + regex `get`/`select`. TPU
+note: a Parameter keeps ONE canonical copy per context (multi-device
+training replicates via the sharded step, not per-ctx copies — SURVEY §2.3).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros
+from .. import initializer as init_mod
+from .. import symbol as sym_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+def _apply_init(init, default_init, name, data):
+    """Apply a chosen initializer. A param-specific init is routed through
+    the InitDesc `__init__` attr so it applies wholesale (running_mean etc.
+    don't match the global initializer's name-dispatch suffixes) — the
+    reference's Parameter._finish_deferred_init contract."""
+    if init is not None and init is not default_init and \
+            isinstance(init, init_mod.Initializer):
+        desc = init_mod.InitDesc(name, {"__init__": init.dumps()})
+        init(desc, data)
+    elif init is not None:
+        init(init_mod.InitDesc(name, {}), data)
+    else:
+        default_init(init_mod.InitDesc(name, {}), data)
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None   # dict ctx -> NDArray
+        self._grad = None
+        self._deferred_init = ()
+        self.name = name
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self._differentiable = differentiable
+        self.grad_req = grad_req
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), \
+            f"grad_req must be write, add, or null, but got {req}"
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null" and self._grad is not None:
+            self._grad = None
+            for v in (self._data or {}).values():
+                v._grad = None
+                v._ag_node = None
+        elif self._data is not None:
+            self._init_grad()
+
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            if isinstance(ctx, Context):
+                key = (ctx.device_type if ctx.device_type != "gpu" else "tpu",
+                       ctx.device_id)
+                for c, v in arr_dict.items():
+                    ckey = (c.device_type if c.device_type != "gpu"
+                            else "tpu", c.device_id)
+                    if ckey == key:
+                        return v
+            raise RuntimeError(
+                f"Parameter '{self.name}' was not initialized on context "
+                f"{ctx}. It was only initialized on "
+                f"{list(arr_dict.keys())}.")
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet "
+                "because initialization was deferred. Actual initialization "
+                "happens during the first forward pass. Please pass one "
+                "batch of data through the network before accessing "
+                "Parameters.")
+        raise RuntimeError(
+            f"Parameter '{self.name}' has not been initialized. Note that "
+            "you should initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks")
+
+    def _load_init(self, data, ctx):
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim in (0, data_dim), \
+                    (f"Failed loading Parameter '{self.name}' from saved "
+                     f"params: shape incompatible expected {self.shape} "
+                     f"vs saved {data.shape}")
+            self.shape = tuple(i if i else j
+                               for i, j in zip(self.shape, data.shape))
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                assert ctx is None or set(ctx) == set(self._deferred_init[1])
+                ctx = self._deferred_init[1]
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        else:
+            assert ctx is None or set(ctx) == set(self._data.keys())
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, _default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and all(s > 0 for s in self.shape), \
+            (f"Cannot initialize Parameter '{self.name}' because it has "
+             f"invalid shape: {self.shape}.")
+        if data is None:
+            data = zeros(self.shape, ctx=ctx[0], dtype=self.dtype)
+            if isinstance(init, str):
+                init = init_mod.create(init)
+            _apply_init(init, _default_init, self.name, data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = OrderedDict()
+        for ctx in ctx_list:
+            if isinstance(data, NDArray):
+                self._data[ctx] = data.as_in_context(ctx) \
+                    if data.context != ctx else data
+            else:
+                self._data[ctx] = NDArray(data)
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        from ..ndarray.ndarray import zeros_like
+        self._grad = OrderedDict()
+        from .. import autograd
+        for ctx, d in self._data.items():
+            g = zeros_like(d)
+            self._grad[ctx] = g
+            autograd.mark_variables([d], [g], self.grad_req)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            import warnings
+            warnings.warn(f"Parameter '{self.name}' is already initialized, "
+                          "ignoring. Set force_reinit=True to re-initialize.",
+                          stacklevel=2)
+            return
+        self._data = self._grad = None
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if not isinstance(default_init, init_mod.Initializer) and \
+                not callable(default_init):
+            default_init = init_mod.create(default_init)
+        # precedence: explicit init arg > param's own init > default_init
+        if init is None:
+            init = self.init if self.init is not None else default_init
+        if isinstance(init, str):
+            init = init_mod.create(init)
+        if self.shape is None or any(s <= 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self.shape}.")
+        data = zeros(self.shape, ctx=ctx[0], dtype=self.dtype)
+        _apply_init(init, default_init, self.name, data)
+        self._init_impl(data, ctx)
+
+    def reset_ctx(self, ctx):
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = list(self._data.values())[0]
+            self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError(f"Cannot reset context for Parameter "
+                             f"'{self.name}' because it has not been "
+                             "initialized.")
+
+    def set_data(self, data):
+        assert self._data is not None, \
+            f"Parameter '{self.name}' has not been initialized"
+        self.shape = tuple(data.shape)
+        for ctx, arr in self._data.items():
+            if isinstance(data, NDArray):
+                data.copyto(arr)
+            else:
+                arr[:] = data
+
+    def data(self, ctx=None):
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError(f"Parameter '{self.name}' has not been "
+                               "initialized")
+        return list(self._data.keys())
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g[:] = 0
+
+    def var(self):
+        if self._var is None:
+            self._var = sym_mod.Variable(self.name, shape=self.shape,
+                                         dtype=self.dtype,
+                                         lr_mult=self.lr_mult,
+                                         wd_mult=self.wd_mult,
+                                         init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with __import__("mxnet_tpu").autograd.pause():
+            self._data = OrderedDict(
+                (ctx, d.astype(dtype)) for ctx, d in self._data.items())
+            self._init_grad()
+
+
+class Constant(Parameter):
+    """Constant parameter: grad_req='null', initialized from `value`."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            from ..ndarray.ndarray import array
+            value = array(value)
+        self.value = value
+
+        class Init(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+        init_name = f"Constant_{name}_{id(self)}"
+        init_mod._INIT_REGISTRY[init_name.lower()] = Init
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix + shared fallback
+    (gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        return f"{name}(\n" + \
+            "\n".join(f"  {v!r}" for v in self.values()) + "\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and \
+                            len(v) == len(existing):
+                        inferred = tuple(
+                            max(i, j) for i, j in zip(v, existing))
+                        if all(i in (0, m) and j in (0, m) for i, j, m in
+                               zip(v, existing, inferred)):
+                            param.shape = inferred
+                            continue
+                    if v is not None and v != existing:
+                        raise AssertionError(
+                            f"Cannot retrieve Parameter '{name}' because "
+                            f"desired attribute does not match with stored "
+                            f"for attribute '{k}': desired '{v}' vs stored "
+                            f"'{existing}'")
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(f"No constant named '{name}'. Please specify "
+                               "value if you want to create a new constant.")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    f"Cannot update self with other because they have " \
+                    f"different Parameters with the same name '{k}'"
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        if verbose and hasattr(init, "set_verbosity"):
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import ndarray as nd
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data() if param._data else None
+            if weight is None:
+                continue
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    f"Prefix '{strip_prefix}' is to be striped before "
+                    f"saving, but Parameter's name '{param.name}' does not "
+                    f"start with '{strip_prefix}'.")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import ndarray as nd
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    (f"restore_prefix is '{restore_prefix}' but Parameter "
+                     f"name '{name}' does not start with it")
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {restore_prefix + k.partition(":")[2]
+                    if k.startswith(("arg:", "aux:")) else restore_prefix + k:
+                    v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    (f"Parameter '{name[lprefix:]}' is missing in file "
+                     f"'{filename}'")
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    (f"Parameter '{name[lprefix:]}' loaded from file "
+                     f"'{filename}' is not present in ParameterDict")
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

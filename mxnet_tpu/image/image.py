@@ -53,8 +53,14 @@ def imread(filename, flag=1, to_rgb=True):
 def imresize(src, w, h, interp=1):
     data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
     if _cv2 is not None:
-        out = _cv2.resize(data, (w, h), interpolation=_cv2.INTER_LINEAR
-                          if interp == 1 else _cv2.INTER_NEAREST)
+        # interp codes follow cv2 enum values (MXNet imresize contract):
+        # 0=nearest 1=bilinear 2=bicubic 3=area 4=lanczos
+        interp_map = {0: _cv2.INTER_NEAREST, 1: _cv2.INTER_LINEAR,
+                      2: _cv2.INTER_CUBIC, 3: _cv2.INTER_AREA,
+                      4: _cv2.INTER_LANCZOS4}
+        out = _cv2.resize(data, (w, h),
+                          interpolation=interp_map.get(interp,
+                                                       _cv2.INTER_LINEAR))
         if out.ndim == 2:
             out = out[:, :, None]
     else:
@@ -382,6 +388,10 @@ class ImageIter(io_mod.DataIter):
                          "inter_method")})
         else:
             self.auglist = aug_list
+        if self.seq is None and (shuffle or num_parts > 1):
+            raise MXNetError(
+                "ImageIter: shuffle/num_parts require path_imgidx or an "
+                "imglist — a bare .rec file is sequential-only")
         self.cur = 0
         self.reset()
 

@@ -46,7 +46,11 @@ class _RawImageRecordIter(io_mod.DataIter):
                                                    "r")
             seq = list(self._rec.keys)
         else:
-            # sequential scan to build an in-memory offset-free sequence
+            if shuffle or num_parts > 1:
+                raise MXNetError(
+                    "ImageRecordIter: shuffle/num_parts require "
+                    "path_imgidx (the .idx seek table) — without it the "
+                    "record file can only be read sequentially")
             self._rec = recordio.MXRecordIO(path_imgrec, "r")
             seq = None
         if seq is not None and num_parts > 1:

@@ -39,6 +39,10 @@ def register(klass):
     return klass
 
 
+def _alias(name, klass_name):
+    _INIT_REGISTRY[name] = _INIT_REGISTRY[klass_name]
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
@@ -369,6 +373,11 @@ class LSTMBias(Initializer):
         num_hidden = int(b.shape[0] / 4)
         b[num_hidden:2 * num_hidden] = self.forget_bias
         arr[:] = b
+
+
+# registry aliases matching the reference's @register names
+_alias("zeros", "zero")
+_alias("ones", "one")
 
 
 class FusedRNN(Initializer):

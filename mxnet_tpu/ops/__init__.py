@@ -4,5 +4,6 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 
 from .registry import get_op, list_ops  # noqa: F401

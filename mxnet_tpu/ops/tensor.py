@@ -628,6 +628,12 @@ register("slice_like", _slice_like, params={"axes": Param("shape", ())},
          inputs=("data", "shape_like"))
 
 
+def _reshape_like(attrs, octx, x, shape_like):
+    return _t(jnp.reshape(x, shape_like.shape))
+
+register("reshape_like", _reshape_like, inputs=("lhs", "rhs"))
+
+
 def _clip(attrs, octx, x):
     return _t(jnp.clip(x, attrs["a_min"], attrs["a_max"]))
 

@@ -73,6 +73,10 @@ class MXRecordIO:
 
     def write(self, buf):
         assert self.writable
+        if len(buf) >= (1 << 29):
+            raise ValueError(
+                f"record too large ({len(buf)} bytes): the dmlc recordio "
+                "length word holds 29 bits (max 512MB per record)")
         self.fp.write(struct.pack("<I", _kMagic))
         self.fp.write(struct.pack("<I", len(buf)))
         self.fp.write(buf)

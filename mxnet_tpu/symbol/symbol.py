@@ -246,6 +246,22 @@ class Symbol:
     def __neg__(self):
         return self._binop(-1.0, None, "_mul_scalar")
 
+    # ordering comparisons (eq/ne intentionally left to identity semantics —
+    # Symbols must stay hashable dict keys, matching the reference)
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal",
+                           "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal",
+                           "_lesser_equal_scalar")
+
     def __getattr__(self, name):
         # symbol method sugar: sym.reshape(...), sym.sum(...) etc
         if name.startswith("_"):

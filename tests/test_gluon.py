@@ -1,0 +1,353 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py +
+test_gluon_model_zoo.py + test_gluon_data.py + test_loss.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu(0))
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_ctx() == [mx.cpu(0)]
+
+
+def test_parameter_dict_get_shared():
+    params1 = gluon.ParameterDict("net1_")
+    p1 = params1.get("w", shape=(2, 2))
+    params2 = gluon.ParameterDict("net1_", shared=params1)
+    p2 = params2.get("w")
+    assert p1 is p2
+
+
+def test_dense_eager_hybrid_match():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).uniform(size=(3, 8)))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_deferred_init_and_reshape():
+    net = nn.Dense(5)
+    net.initialize()
+    # shape unknown until first forward
+    out = net(mx.nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert net.weight.shape == (5, 7)
+
+
+def test_conv_block_shapes():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D())
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(4))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 4)
+    net.hybridize()
+    out2 = net(mx.nd.ones((2, 3, 16, 16)))
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5)
+
+
+def test_batchnorm_updates_running_stats():
+    net = nn.BatchNorm(in_channels=4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).normal(3, 2, size=(8, 4)))
+    with mx.autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0, "running mean should move under training"
+
+
+def test_block_save_load_parameters(tmp_path):
+    fname = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    y = net(mx.nd.ones((1, 3))).asnumpy()
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    y2 = net2(mx.nd.ones((1, 3))).asnumpy()
+    np.testing.assert_allclose(y, y2, rtol=1e-6)
+
+
+def test_trainer_convergence():
+    rng = np.random.RandomState(0)
+    centers = rng.uniform(-2, 2, size=(3, 6)).astype(np.float32)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    for i in range(60):
+        y = rng.randint(0, 3, size=32)
+        x = centers[y] + rng.normal(0, 0.3, size=(32, 6)).astype(np.float32)
+        xb, yb = mx.nd.array(x), mx.nd.array(y.astype(np.float32))
+        with mx.autograd.record():
+            out = net(xb)
+            loss = loss_fn(out, yb)
+        loss.backward()
+        trainer.step(32)
+    acc = (net(xb).asnumpy().argmax(1) == y).mean()
+    assert acc > 0.9, acc
+
+
+def test_losses_values():
+    pred = mx.nd.array([[1.0, 2.0], [0.5, 0.5]])
+    label = mx.nd.array([[1.5, 1.5], [1.0, 0.0]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l2, [((0.5 ** 2) + (0.5 ** 2)) / 2 / 2,
+             ((0.5 ** 2) + (0.5 ** 2)) / 2 / 2], rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l1, [0.5, 0.5], rtol=1e-5)
+    # softmax CE vs manual
+    logits = mx.nd.array([[1.0, 2.0, 3.0]])
+    y = mx.nd.array([2.0])
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(logits, y).asnumpy()
+    p = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    np.testing.assert_allclose(ce, [-np.log(p[2])], rtol=1e-5)
+    # hinge
+    hl = gluon.loss.HingeLoss()(mx.nd.array([[0.5]]),
+                                mx.nd.array([[1.0]])).asnumpy()
+    np.testing.assert_allclose(hl, [0.5], rtol=1e-5)
+
+
+def test_sigmoid_bce_stable():
+    pred = mx.nd.array([[100.0], [-100.0]])
+    label = mx.nd.array([[1.0], [0.0]])
+    loss = gluon.loss.SigmoidBCELoss()(pred, label).asnumpy()
+    np.testing.assert_allclose(loss, [0.0, 0.0], atol=1e-4)
+
+
+def test_dataset_dataloader():
+    X = np.arange(40).reshape(20, 2).astype(np.float32)
+    Y = np.arange(20).astype(np.float32)
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 20
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(x0, X[3])
+    loader = gluon.data.DataLoader(ds, batch_size=6, shuffle=False,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 2)
+    assert batches[-1][0].shape == (2, 2)
+    # threaded loader yields same content
+    loader2 = gluon.data.DataLoader(ds, batch_size=5, num_workers=2)
+    total = np.concatenate([b[1].asnumpy() for b in loader2])
+    np.testing.assert_allclose(np.sort(total), Y)
+
+
+def test_dataset_transform():
+    ds = gluon.data.ArrayDataset(np.ones((4, 2), np.float32))
+    ds2 = ds.transform(lambda x: x * 2)
+    np.testing.assert_allclose(ds2[0], 2.0)
+
+
+def test_vision_mnist_synthetic():
+    ds = gluon.data.vision.MNIST(root="/nonexistent_mnist", train=True)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= int(label) < 10
+    tf = gluon.data.vision.transforms.ToTensor()
+    out = tf(img)
+    assert out.shape == (1, 28, 28)
+    assert float(out.asnumpy().max()) <= 1.0
+
+
+def test_model_zoo_construct_and_forward_small():
+    # thumbnail resnet handles 32x32 (cifar-style)
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    out = net(mx.nd.ones((1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+    net2 = gluon.model_zoo.vision.resnet18_v2(classes=10, thumbnail=True)
+    net2.initialize()
+    assert net2(mx.nd.ones((1, 3, 32, 32))).shape == (1, 10)
+
+
+def test_model_zoo_get_model_names():
+    with pytest.raises(ValueError):
+        gluon.model_zoo.get_model("not_a_model")
+    for name in ("alexnet", "squeezenet1.0", "mobilenet0.25", "vgg11",
+                 "densenet121"):
+        net = gluon.model_zoo.get_model(name, classes=10)
+        assert net is not None
+
+
+def test_mobilenet_forward():
+    net = gluon.model_zoo.vision.mobilenet0_25(classes=10)
+    net.initialize()
+    out = net(mx.nd.ones((1, 3, 64, 64)))
+    assert out.shape == (1, 10)
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    inputs = [mx.nd.ones((2, 4)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 8)
+    assert len(states) == 2
+
+    gcell = gluon.rnn.GRUCell(8, input_size=4)
+    gcell.initialize()
+    outputs, states = gcell.unroll(3, inputs)
+    assert outputs[0].shape == (2, 8)
+
+    rcell = gluon.rnn.RNNCell(8, input_size=4)
+    rcell.initialize()
+    outputs, states = rcell.unroll(3, inputs, merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+
+
+def test_sequential_rnn_and_bidirectional():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8, input_size=4))
+    stack.add(gluon.rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    inputs = [mx.nd.ones((2, 4)) for _ in range(3)]
+    outputs, states = stack.unroll(3, inputs)
+    assert outputs[0].shape == (2, 8)
+    assert len(states) == 4
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4, input_size=4),
+                                     gluon.rnn.LSTMCell(4, input_size=4))
+    bi.initialize()
+    outputs, states = bi.unroll(3, inputs)
+    assert outputs[0].shape == (2, 8)
+
+
+def test_fused_lstm_layer():
+    layer = gluon.rnn.LSTM(8, num_layers=2, layout="TNC", input_size=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).uniform(size=(5, 2, 4)))
+    out = layer(x)
+    assert out.shape == (5, 2, 8)
+    # with explicit states
+    states = layer.begin_state(batch_size=2)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 2, 8)
+    assert new_states[0].shape == (2, 2, 8)
+    assert new_states[1].shape == (2, 2, 8)
+
+
+def test_fused_lstm_matches_cell_unroll():
+    """Fused RNN op output == LSTMCell unroll (backend parity check in the
+    reference's check_rnn_consistency style)."""
+    rng = np.random.RandomState(7)
+    T, N, I, H = 4, 3, 5, 6
+    x = rng.uniform(-1, 1, size=(T, N, I)).astype(np.float32)
+
+    layer = gluon.rnn.LSTM(H, num_layers=1, layout="TNC", input_size=I)
+    layer.initialize()
+    out_fused = layer(mx.nd.array(x)).asnumpy()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused params into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    inputs = [mx.nd.array(x[t]) for t in range(T)]
+    outputs, _ = cell.unroll(T, inputs)
+    out_cell = np.stack([o.asnumpy() for o in outputs])
+    np.testing.assert_allclose(out_fused, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_hybridized_lstm_with_state_list():
+    """Regression: hybridized blocks must handle nested list args
+    (states) and regroup nested outputs."""
+    layer = gluon.rnn.LSTM(8, num_layers=1, layout="TNC", input_size=4)
+    layer.initialize()
+    x = mx.nd.ones((5, 2, 4))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    out = layer(x)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    states = layer.begin_state(batch_size=2)
+    # different arg structure than the first trace → explicit error
+    with pytest.raises(ValueError):
+        layer(x, states)
+    layer.hybridize()  # re-trace with the stateful signature
+    out2, new_states = layer(x, states)
+    assert out2.shape == (5, 2, 8)
+    assert isinstance(new_states, list) and len(new_states) == 2
+    assert new_states[0].shape == (1, 2, 8)
+
+
+def test_gru_layer_and_rnn_layer():
+    for layer, H in ((gluon.rnn.GRU(6, input_size=4), 6),
+                     (gluon.rnn.RNN(6, input_size=4, activation="tanh"), 6)):
+        layer.initialize()
+        out = layer(mx.nd.ones((3, 2, 4)))
+        assert out.shape == (3, 2, H)
+
+
+def test_bidirectional_fused_lstm():
+    layer = gluon.rnn.LSTM(5, num_layers=1, bidirectional=True,
+                           input_size=3)
+    layer.initialize()
+    out = layer(mx.nd.ones((4, 2, 3)))
+    assert out.shape == (4, 2, 10)
+
+
+def test_symbolblock():
+    data = mx.sym.Variable("data")
+    out_sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    blk = gluon.SymbolBlock(out_sym, data)
+    blk.initialize()
+    out = blk(mx.nd.ones((2, 6)))
+    assert out.shape == (2, 4)
+
+
+def test_autograd_through_hybridized_cached_graph():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((4, 3))
+    with mx.autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    for _, p in net.collect_params().items():
+        g = p.grad().asnumpy()
+        assert g.shape == p.shape
+
+
+def test_split_and_load_clip_global_norm():
+    arrs = [mx.nd.ones((2, 3)) * 3, mx.nd.ones((4,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrs, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
+    assert abs(total - 1.0) < 1e-5
+    parts = gluon.utils.split_and_load(np.arange(12).reshape(6, 2),
+                                       [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
