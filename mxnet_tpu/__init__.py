@@ -48,3 +48,4 @@ from . import module as mod
 from . import parallel
 from . import image
 from . import gluon
+from . import rnn

@@ -268,8 +268,26 @@ class Module(BaseModule):
             ctx=ctx, grad_req=reqs, type_dict=type_kwargs, **shape_kwargs)
         self.binded = True
 
-        if shared_module is not None and shared_module.params_initialized:
-            self.set_params(*shared_module.get_params())
+        if shared_module is not None:
+            # share parameter/grad STORAGE with the shared module — the
+            # reference's shared-executor memory model (BucketingModule):
+            # all buckets update the same arrays
+            src = shared_module._exec
+            for n in self._param_names:
+                if n in src.arg_dict:
+                    self._exec.arg_dict[n] = src.arg_dict[n]
+                    if n in src.grad_dict and n in self._exec.grad_dict:
+                        self._exec.grad_dict[n] = src.grad_dict[n]
+            for n in self._aux_names:
+                if n in src.aux_dict:
+                    self._exec.aux_dict[n] = src.aux_dict[n]
+            ex = self._exec
+            ex.arg_arrays = [ex.arg_dict[n] for n in ex._arg_names]
+            ex.grad_arrays = [ex.grad_dict.get(n) for n in ex._arg_names]
+            ex.aux_arrays = [ex.aux_dict[n] for n in ex._aux_names]
+            if shared_module.params_initialized:
+                self.params_initialized = True
+                self._sync_params_from_devices()
 
     # -- optimizer -----------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -435,6 +453,15 @@ class Module(BaseModule):
             self._kvstore.load_optimizer_states(fname)
         else:
             self._updater.set_states(open(fname, "rb").read())
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer state with another module (BucketingModule)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
 
     def install_monitor(self, mon):
         assert self.binded

@@ -245,6 +245,25 @@ register("_rnn_state_zeros", _state_zeros,
          inputs=("data",), infer_shape=_state_zeros_infer)
 
 
+def _cell_state_zeros(attrs, octx, data):
+    # per-step cell state: zeros (N, dim) with N from the (N, ...) input —
+    # the reference's 0-means-unknown begin_state shape contract realized
+    # with static shapes
+    return (jnp.zeros((data.shape[0], attrs["dim"]), data.dtype),)
+
+
+def _cell_state_zeros_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None]
+    return in_shapes, [(ds[0], attrs["dim"])]
+
+
+register("_cell_state_zeros", _cell_state_zeros,
+         params={"dim": Param("int", None, True)},
+         inputs=("data",), infer_shape=_cell_state_zeros_infer)
+
+
 def _rnn_inputs(attrs):
     if attrs["mode"] == "lstm":
         return ["data", "parameters", "state", "state_cell"]

@@ -1,0 +1,139 @@
+"""Symbolic RNN cells + BucketingModule tests (reference:
+tests/python/unittest/test_rnn.py + test_bucketing.py / LSTM LM config)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=False)
+    sym = mx.sym.Group(outputs)
+    args, outs, _ = sym.infer_shape(data=(4, 3, 8))
+    assert all(o == (4, 10) for o in outs)
+    assert len(states) == 2
+
+
+def test_gru_cell_unroll():
+    cell = mx.rnn.GRUCell(6, prefix="gru_")
+    outputs, states = cell.unroll(4, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 4, 5))
+    assert outs[0] == (2, 4, 6)
+
+
+def test_stacked_residual_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="l1_")))
+    outputs, states = stack.unroll(3, inputs=mx.sym.Variable("data"),
+                                   merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 8))
+    assert outs[0] == (2, 3, 8)
+
+
+def test_bidirectional_cell_unroll():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="l_"),
+                                    mx.rnn.LSTMCell(4, prefix="r_"))
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 6))
+    assert outs[0] == (2, 3, 8)
+
+
+def test_fused_cell_unroll_and_unfuse():
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                                prefix="lstm_")
+    outputs, _ = fused.unroll(5, inputs=mx.sym.Variable("data"),
+                              merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(3, 5, 4))
+    assert outs[0] == (3, 5, 8)
+    stack = fused.unfuse()
+    outputs2, _ = stack.unroll(5, inputs=mx.sym.Variable("data"),
+                               merge_outputs=True)
+    _, outs2, _ = outputs2.infer_shape(data=(3, 5, 4))
+    assert outs2[0] == (3, 5, 8)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "b"],
+                 ["a", "b"], ["c", "b", "a"]] * 10
+    coded, vocab = mx.rnn.encode_sentences(sentences, start_label=1)
+    assert len(vocab) >= 3
+    it = mx.rnn.BucketSentenceIter(coded, batch_size=5, buckets=[2, 3, 4],
+                                   invalid_label=0)
+    batch = next(it)
+    assert batch.bucket_key in (2, 3, 4)
+    assert batch.data[0].shape == (5, batch.bucket_key)
+
+
+def _lm_sym_gen(vocab_size, num_hidden, num_embed):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=num_embed, name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden, prefix="lstm_l0_")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def test_bucketing_module_lstm_lm():
+    """LSTM LM via BucketingModule (SURVEY.md §7 config 4 slice):
+    per-bucket executors share parameters; training reduces perplexity."""
+    vocab_size, num_hidden, num_embed = 20, 16, 8
+    rng = np.random.RandomState(0)
+    # synthetic 'language': deterministic successor chains are learnable
+    sentences = []
+    for _ in range(200):
+        start = rng.randint(1, vocab_size - 5)
+        length = rng.choice([3, 5])
+        sentences.append([start + i for i in range(length)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=20, buckets=[3, 5],
+                                   invalid_label=0)
+
+    mod = mx.mod.BucketingModule(
+        _lm_sym_gen(vocab_size, num_hidden, num_embed),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+    metric = mx.metric.Perplexity(ignore_label=None)
+
+    ppl0 = None
+    for epoch in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        if ppl0 is None:
+            ppl0 = metric.get()[1]
+    ppl1 = metric.get()[1]
+    assert len(mod._buckets) == 2, "both buckets should have bound modules"
+    assert ppl1 < ppl0 * 0.5, (ppl0, ppl1)
+
+
+def test_unpack_pack_weights():
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    cell.unroll(2, inputs=mx.sym.Variable("data"))
+    args = {"lstm_i2h_weight": mx.nd.ones((16, 3)),
+            "lstm_i2h_bias": mx.nd.zeros((16,)),
+            "lstm_h2h_weight": mx.nd.ones((16, 4)),
+            "lstm_h2h_bias": mx.nd.zeros((16,))}
+    unpacked = cell.unpack_weights(args)
+    assert "lstm_i2h_i_weight" in unpacked
+    assert unpacked["lstm_i2h_i_weight"].shape == (4, 3)
+    packed = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["lstm_i2h_weight"].asnumpy(),
+                               args["lstm_i2h_weight"].asnumpy())
