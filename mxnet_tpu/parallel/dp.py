@@ -123,27 +123,41 @@ class DataParallelTrainer:
                 arr = nd_zeros(s)
                 from ..initializer import InitDesc
                 initializer(InitDesc(n), arr)
-                v = arr._data
+                v = _np.asarray(arr._data)
             else:
-                v = jnp.asarray(
-                    rng.normal(0, 0.01, size=s).astype(_np.float32))
+                v = rng.normal(0, 0.01, size=s).astype(_np.float32)
+            # host numpy straight onto the mesh (see shard_inputs)
             params.append(jax.device_put(v, self._repl))
-        momenta = tuple(jax.device_put(jnp.zeros_like(p), self._repl)
+        momenta = tuple(jax.device_put(_np.zeros(p.shape, p.dtype),
+                                       self._repl)
                         for p in params)
         aux = tuple(jax.device_put(
             # moving variances start at 1 (MXNet BatchNorm aux parity)
-            jnp.ones(s, _np.float32) if n.endswith("moving_var")
-            else jnp.zeros(s, _np.float32), self._repl)
+            _np.ones(s, _np.float32) if n.endswith("moving_var")
+            else _np.zeros(s, _np.float32), self._repl)
             for n, s in zip(self._aux_names, aux_shapes))
         return tuple(params), momenta, aux
 
     def shard_inputs(self, arrays):
-        """Commit host batch arrays to the mesh, sharded on axis 0."""
-        return tuple(jax.device_put(jnp.asarray(a), self._shard)
-                     for a in arrays)
+        """Commit host batch arrays to the mesh, sharded on axis 0.
+
+        Host numpy goes straight to the mesh sharding — never through
+        `jnp.asarray`, which would commit to the *default* device first
+        (wrong platform when the mesh is not on the default backend).
+        """
+        out = []
+        for a in arrays:
+            a = getattr(a, "_data", a)
+            if not isinstance(a, jax.Array):
+                a = _np.asarray(a)
+            out.append(jax.device_put(a, self._shard))
+        return tuple(out)
 
     def step(self, params, momenta, aux, inputs, rng=None):
         if rng is None:
             from .. import random as _random
             rng = _random.next_key()
+        # the key may have been minted on the default backend; commit it to
+        # the mesh so the step never mixes platforms
+        rng = jax.device_put(rng, self._repl)
         return self._step(params, momenta, aux, inputs, rng)

@@ -88,6 +88,24 @@ def test_dryrun_multichip_hook():
     ge.dryrun_multichip(8)
 
 
+def test_dryrun_multichip_driver_env():
+    """Round-1 regression: run the hook in a FRESH interpreter without the
+    conftest's cpu-platform forcing — the driver's environment, where the
+    default backend is the axon TPU. The hook itself must force the CPU
+    mesh before any backend touch (MULTICHIP_r01.json failure)."""
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "JAX_NUM_CPU_DEVICES", "XLA_FLAGS")}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as ge; ge.dryrun_multichip(8); print('OK')"],
+        cwd="/root/repo", env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
+
+
 def test_entry_hook_compiles():
     import sys
     sys.path.insert(0, "/root/repo")
