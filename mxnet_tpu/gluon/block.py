@@ -282,6 +282,7 @@ class HybridBlock(Block):
         self._active = False
         self._cached_graph = ()
         self._cached_run = {}
+        self._cached_rec = {}
         self._cached_fmt = None
         self._out_fmt = None
         self._flags = {}
@@ -313,6 +314,7 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._cached_graph = ()
         self._cached_run = {}
+        self._cached_rec = {}
         self._cached_fmt = None
         self._out_fmt = None
 
@@ -419,10 +421,15 @@ class HybridBlock(Block):
         for arr, new in zip(aux_arrays, results[n_out:]):
             arr._rebind(new)
         if autograd.is_recording():
-            autograd._record_fn(
-                lambda *arrays, _r=run, _rng=rng:
-                    _r(*arrays, _rng)[:n_out],
-                arg_arrays + aux_arrays, outputs, n_out=n_out)
+            # the recorded fn must have STABLE identity across steps (it is
+            # the autograd replay-cache key); rng rides as AGNode.rng
+            rec = self._cached_rec.get(key)
+            if rec is None:
+                def rec(rng_, *arrays, _r=run, _n=n_out):
+                    return _r(*arrays, rng_)[:_n]
+                self._cached_rec[key] = rec
+            autograd._record_fn(rec, arg_arrays + aux_arrays, outputs,
+                                n_out=n_out, rng=rng)
         if self._out_fmt is not None:
             regrouped = _regroup_args(outputs, self._out_fmt)
             return tuple(regrouped) if len(regrouped) > 1 else regrouped[0]

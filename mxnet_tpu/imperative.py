@@ -114,11 +114,18 @@ def apply_fn(fn, inputs, jit_key=None, num_outputs=1):
     from . import autograd
     from .ndarray.ndarray import NDArray
 
+    rec_fn = fn
     if jit_key is not None:
         jfn = _JIT_CACHE.get(jit_key)
         if jfn is None:
             jfn = jax.jit(fn)
             _JIT_CACHE[jit_key] = jfn
+        # record a STABLE fn object per jit_key so the autograd replay
+        # cache keys stay equal across steps (fresh closures never hit)
+        rec_key = ("raw", jit_key)
+        rec_fn = _JIT_CACHE.get(rec_key)
+        if rec_fn is None:
+            _JIT_CACHE[rec_key] = rec_fn = fn
     else:
         jfn = jax.jit(fn)
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
@@ -127,5 +134,5 @@ def apply_fn(fn, inputs, jit_key=None, num_outputs=1):
         results = (results,)
     outputs = [NDArray(r) for r in results]
     if autograd.is_recording():
-        autograd._record_fn(fn, inputs, outputs)
+        autograd._record_fn(rec_fn, inputs, outputs)
     return outputs if num_outputs > 1 else outputs[0]
