@@ -74,10 +74,23 @@ def _build_runner(symbol, is_train):
 
 class Executor:
     def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req_dict,
-                 aux_dict):
+                 aux_dict, mesh=None, sharded_args=()):
         from .ndarray.ndarray import NDArray
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # Multi-device data parallelism: ONE program sharded over `mesh`
+        # (role of DataParallelExecutorGroup's per-device executor replicas,
+        # executor_group.py:129). `sharded_args` (data/label names) are
+        # batch-sharded on axis 0; params/aux replicated; XLA inserts the
+        # gradient psum over ICI.
+        self._mesh = mesh
+        self._sharded_args = frozenset(sharded_args)
+        if mesh is not None:
+            from .parallel.mesh import replicated_sharding, batch_sharding
+            self._repl_sharding = replicated_sharding(mesh)
+            self._batch_sharding = batch_sharding(mesh)
+        else:
+            self._repl_sharding = self._batch_sharding = None
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
@@ -101,7 +114,8 @@ class Executor:
 
     # -- construction helpers ----------------------------------------------
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     mesh=None, sharded_args=()):
         from .ndarray import ndarray as ndmod
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
         arg_names = symbol.list_arguments()
@@ -122,7 +136,8 @@ class Executor:
             req_dict[n] = reqs[n]
         aux_dict = {n: ndmod.zeros(s, ctx=ctx)
                     for n, s in zip(aux_names, aux_shapes)}
-        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict,
+                        mesh=mesh, sharded_args=sharded_args)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
@@ -166,11 +181,34 @@ class Executor:
         return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
 
     # -- execution ----------------------------------------------------------
+    def _arg_sharding(self, name):
+        return self._batch_sharding if name in self._sharded_args \
+            else self._repl_sharding
+
     def _arg_values(self):
-        return tuple(self.arg_dict[n]._data for n in self._arg_names)
+        if self._mesh is None:
+            return tuple(self.arg_dict[n]._data for n in self._arg_names)
+        # re-commit to the mesh: no-op when already placed; heals arrays
+        # rebound off-mesh (init_params, set_params, [:]=). Write the healed
+        # array back so the broadcast happens once, not per batch.
+        out = []
+        for n in self._arg_names:
+            nd = self.arg_dict[n]
+            v = jax.device_put(nd._data, self._arg_sharding(n))
+            nd._data = v
+            out.append(v)
+        return tuple(out)
 
     def _aux_values(self):
-        return tuple(self.aux_dict[n]._data for n in self._aux_names)
+        if self._mesh is None:
+            return tuple(self.aux_dict[n]._data for n in self._aux_names)
+        out = []
+        for n in self._aux_names:
+            nd = self.aux_dict[n]
+            v = jax.device_put(nd._data, self._repl_sharding)
+            nd._data = v
+            out.append(v)
+        return tuple(out)
 
     def forward(self, is_train=False, **kwargs):
         from .ndarray.ndarray import NDArray
@@ -179,13 +217,29 @@ class Executor:
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"forward: unknown argument {k}")
-            new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            new = v._data if isinstance(v, NDArray) else v
+            if not isinstance(new, jax.Array):
+                new = _np.asarray(new)
             # incoming batch arrays may live on another device (host-side
             # iterators commit to cpu): the executor owns placement —
-            # this is the reference's kCopyToGPU engine lane
-            self.arg_dict[k]._data = jax.device_put(new, dev)
+            # this is the reference's kCopyToGPU engine lane. Mesh mode
+            # shards the batch axis across devices instead.
+            if self._mesh is not None:
+                if k in self._sharded_args and new.shape and \
+                        new.shape[0] % self._mesh.devices.size != 0:
+                    raise MXNetError(
+                        f"forward: batch size {new.shape[0]} of '{k}' must "
+                        f"be divisible by the {self._mesh.devices.size}-"
+                        "device mesh (pad or drop the last batch, e.g. "
+                        "NDArrayIter(..., last_batch_handle='discard'))")
+                target = self._arg_sharding(k)
+            else:
+                target = dev
+            self.arg_dict[k]._data = jax.device_put(new, target)
 
-        rng = jax.device_put(_random.next_key(), dev)
+        rng = _random.next_key()
+        rng = jax.device_put(
+            rng, self._repl_sharding if self._mesh is not None else dev)
         if self._monitor_callback is not None:
             if not is_train:
                 self._pending = self._pending_grads = None
@@ -232,6 +286,8 @@ class Executor:
                 args[p] = v
             return run(tuple(args), aux, rng)
 
+        repl = self._repl_sharding
+
         def fwd_bwd(diff_vals, other_vals, aux, rng, cts):
             outputs, vjp_fn, new_aux = jax.vjp(
                 lambda d: merged(d, other_vals, aux, rng),
@@ -239,6 +295,13 @@ class Executor:
             if cts is None:
                 cts = tuple(jnp.ones_like(o) for o in outputs)
             (dgrads,) = vjp_fn(tuple(cts))
+            if repl is not None:
+                # pin grads/aux to replicated so the batch-reduction psum
+                # happens inside this program, not lazily downstream
+                dgrads = tuple(jax.lax.with_sharding_constraint(g, repl)
+                               for g in dgrads)
+                new_aux = tuple(jax.lax.with_sharding_constraint(a, repl)
+                                for a in new_aux)
             return outputs, new_aux, dgrads
 
         self._fused_ones = jax.jit(
@@ -400,4 +463,5 @@ class Executor:
                         else ndmod.zeros(s, ctx=self._ctx))
                     for n, s in zip(self._aux_names, aux_shapes)}
         return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
-                        dict(self._grad_req), aux_dict)
+                        dict(self._grad_req), aux_dict, mesh=self._mesh,
+                        sharded_args=self._sharded_args)

@@ -158,12 +158,24 @@ class Parameter:
 
     def _init_impl(self, data, ctx_list):
         self._data = OrderedDict()
-        for ctx in ctx_list:
-            if isinstance(data, NDArray):
-                self._data[ctx] = data.as_in_context(ctx) \
-                    if data.context != ctx else data
-            else:
-                self._data[ctx] = NDArray(data)
+        if len(ctx_list) > 1:
+            # TPU-native multi-device: ONE array replicated over the mesh of
+            # the given contexts (not per-ctx copies — the sharded step does
+            # the reduction; reference keeps N copies + kvstore reduce).
+            # Every ctx key maps to the SAME NDArray.
+            from ..parallel.mesh import mesh_for_contexts, put_replicated
+            mesh = mesh_for_contexts(ctx_list)
+            repl = NDArray(put_replicated(
+                data._data if isinstance(data, NDArray) else data, mesh))
+            for ctx in ctx_list:
+                self._data[ctx] = repl
+        else:
+            for ctx in ctx_list:
+                if isinstance(data, NDArray):
+                    self._data[ctx] = data.as_in_context(ctx) \
+                        if data.context != ctx else data
+                else:
+                    self._data[ctx] = NDArray(data)
         self._init_grad()
 
     def _init_grad(self):
@@ -173,8 +185,13 @@ class Parameter:
         from ..ndarray.ndarray import zeros_like
         self._grad = OrderedDict()
         from .. import autograd
+        seen = {}
         for ctx, d in self._data.items():
+            if id(d) in seen:  # mesh-replicated: one shared grad buffer
+                self._grad[ctx] = seen[id(d)]
+                continue
             g = zeros_like(d)
+            seen[id(d)] = g
             self._grad[ctx] = g
             autograd.mark_variables([d], [g], self.grad_req)
 

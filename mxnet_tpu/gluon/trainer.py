@@ -123,25 +123,45 @@ class Trainer:
             "creating trainer."
         self._allreduce_grads()
 
+    @staticmethod
+    def _unique(arrays):
+        # mesh-replicated params expose N references to ONE array; the
+        # kvstore must see it once or it would sum the same grad N times
+        out, seen = [], set()
+        for a in arrays:
+            if id(a) not in seen:
+                seen.add(id(a))
+                out.append(a)
+        return out
+
     def _allreduce_grads(self):
         if self._kvstore and not self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+                    grads = self._unique(param.list_grad())
+                    self._kvstore.push(i, grads, priority=-i)
+                    self._kvstore.pull(i, grads, priority=-i)
 
     def _update(self, ignore_stale_grad=False):
         if self._kvstore and self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
-                    self._kvstore.push(i, param.list_grad(), priority=-i)
-                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                    self._kvstore.push(i, self._unique(param.list_grad()),
+                                       priority=-i)
+                    self._kvstore.pull(i, self._unique(param.list_data()),
+                                       priority=-i)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            seen = set()
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
+                # mesh-replicated params share ONE array across all ctx
+                # slots — apply the update exactly once
+                if id(arr) in seen:
+                    continue
+                seen.add(id(arr))
                 upd(i, grad, arr)
 
     def update(self, batch_size, ignore_stale_grad=False):

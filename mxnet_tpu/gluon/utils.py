@@ -37,12 +37,35 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    if not isinstance(data, NDArray):
-        data = array(data, ctx=ctx_list[0])
+    """Load a batch onto a context list.
+
+    One context: same as the reference. Multiple contexts: TPU-natively the
+    batch is committed ONCE, sharded on `batch_axis` over the contexts'
+    device mesh, and returned as a single-element list — user loops written
+    against the reference API (`for x in split_and_load(...)`) run one
+    iteration covering the whole (sharded) batch; parameters initialized on
+    the same ctx list are mesh-replicated, so ops compile SPMD with the
+    gradient psum fused in (role of executor_group.py decide_slices +
+    kvstore reduce)."""
     if len(ctx_list) == 1:
+        if not isinstance(data, NDArray):
+            data = array(data, ctx=ctx_list[0])
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+    from ..parallel.mesh import (mesh_for_contexts, put_batch_sharded,
+                                 put_replicated)
+    mesh = mesh_for_contexts(ctx_list)
+    size = data.shape[batch_axis]
+    if size % len(ctx_list) != 0:
+        if even_split:
+            raise ValueError(
+                f"data with shape {tuple(data.shape)} cannot be evenly "
+                f"split into {len(ctx_list)} slices along axis "
+                f"{batch_axis}. Use a batch size that's a multiple of "
+                f"{len(ctx_list)} or set even_split=False.")
+        # uneven last batch: replicate it — every device computes the full
+        # (small) batch; correct math, no crash, negligible cost
+        return [NDArray(put_replicated(data, mesh))]
+    return [NDArray(put_batch_sharded(data, mesh, batch_axis))]
 
 
 def clip_global_norm(arrays, max_norm):

@@ -45,6 +45,34 @@ def jitted_for_schema(schema: OpSchema, attrs, is_train: bool):
     return fn
 
 
+def _reconcile_mesh(datas):
+    """If any input is committed to a multi-device mesh, lift single-device
+    inputs that live on a member device up to replicated on that mesh.
+
+    This is the mesh analog of 'ops run on their inputs' context': a
+    mesh-replicated parameter next to a freshly-created state array (e.g.
+    optimizer create_state zeros) must compile as ONE SPMD program, not
+    error on mixed commitment. Inputs on a foreign device still error."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    multi = None
+    for d in datas:
+        sh = getattr(d, "sharding", None)
+        if sh is not None and len(d.devices()) > 1:
+            multi = sh
+            break
+    if multi is None or not isinstance(multi, NamedSharding):
+        return datas
+    dev_set = set(multi.mesh.devices.flat)
+    repl = NamedSharding(multi.mesh, PartitionSpec())
+    out = []
+    for d in datas:
+        if isinstance(d, jax.Array) and len(d.devices()) == 1 and \
+                next(iter(d.devices())) in dev_set:
+            d = jax.device_put(d, repl)
+        out.append(d)
+    return out
+
+
 def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
            ctx=None):
     """Execute an op imperatively on NDArrays; records on the autograd tape.
@@ -68,6 +96,7 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
 
     fn = jitted_for_schema(schema, attrs, is_train)
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    datas = _reconcile_mesh(datas)
     rng = _random.next_key() if schema.needs_rng else None
     results = fn(rng, *datas) if schema.needs_rng else fn(*datas)
     if not isinstance(results, tuple):

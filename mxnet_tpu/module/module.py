@@ -263,10 +263,34 @@ class Module(BaseModule):
                 reqs[name] = "null"
         self._grad_req = reqs
 
+        # Multi-context = ONE executor sharded over the devices' mesh (the
+        # TPU-native DataParallelExecutorGroup, executor_group.py:129):
+        # batch axis sharded across the mesh, params replicated, gradient
+        # psum fused into the step by XLA.
         ctx = self._context[0]
+        mesh, sharded = None, ()
+        if len(self._context) > 1:
+            from ..parallel.mesh import mesh_for_contexts
+            mesh = mesh_for_contexts(self._context)
+            sharded = tuple(self._data_names) + tuple(self._label_names)
+            n = len(self._context)
+            for d in self._data_shapes + self._label_shapes:
+                if d.shape and d.shape[0] % n != 0:
+                    raise MXNetError(
+                        f"batch size {d.shape[0]} of input '{d.name}' must "
+                        f"be divisible by the number of contexts ({n})")
         self._exec = self._symbol.simple_bind(
-            ctx=ctx, grad_req=reqs, type_dict=type_kwargs, **shape_kwargs)
+            ctx=ctx, grad_req=reqs, type_dict=type_kwargs, mesh=mesh,
+            sharded_args=sharded, **shape_kwargs)
         self.binded = True
+
+        # already-initialized params (Module.load / rebind) must reach the
+        # fresh executor (reference: bind → exec_group.set_params when
+        # params_initialized, module.py:390)
+        if shared_module is None and self.params_initialized and \
+                self._arg_params is not None:
+            self._exec.copy_params_from(self._arg_params,
+                                        self._aux_params or {})
 
         if shared_module is not None:
             # share parameter/grad STORAGE with the shared module — the

@@ -140,7 +140,10 @@ class NDArray:
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()))
         if isinstance(other, NDArray):
-            new = jax.device_put(self._data, other.context.jax_device())
+            # preserve the destination's committed placement, including a
+            # multi-device mesh sharding (mesh-replicated parameters must
+            # stay replicated across set_data/copyto)
+            new = jax.device_put(self._data, other._data.sharding)
             if other.dtype != self.dtype:
                 new = new.astype(other.dtype)
             other._rebind(new)
@@ -478,15 +481,15 @@ class NDArray:
             v = _np.asarray(value).astype(self.dtype)
         import jax
         import jax.numpy as jnp
-        dev = self.context.jax_device()
         if isinstance(key, slice) and key == slice(None):
             new = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype),
                                    self.shape)
         else:
             new = self._data.at[key].set(v)
-        # keep the buffer committed to its device: MXNet NDArrays never
-        # migrate on mutation (ndarray.h Chunk ctx is fixed)
-        self._rebind(jax.device_put(new, dev))
+        # keep the buffer committed to its placement (single device OR mesh
+        # sharding): MXNet NDArrays never migrate on mutation (ndarray.h
+        # Chunk ctx is fixed)
+        self._rebind(jax.device_put(new, self._data.sharding))
 
 
 def _from_numpy_reduce(arr):

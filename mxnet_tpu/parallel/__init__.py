@@ -10,7 +10,11 @@ axis; parameters are replicated; XLA inserts the psum over ICI where the
 scalar loss sums across the sharded batch. Multi-host: the same program runs
 under jax.distributed with a global mesh (DCN between slices).
 """
-from .mesh import build_mesh, data_parallel_mesh
+from .mesh import (build_mesh, data_parallel_mesh, mesh_for_contexts,
+                   mesh_for_devices, replicated_sharding, batch_sharding,
+                   put_replicated, put_batch_sharded)
 from .dp import DataParallelTrainer
 
-__all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer"]
+__all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer",
+           "mesh_for_contexts", "mesh_for_devices", "replicated_sharding",
+           "batch_sharding", "put_replicated", "put_batch_sharded"]

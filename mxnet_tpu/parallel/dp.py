@@ -17,20 +17,38 @@ from ..base import MXNetError
 from ..executor import _build_runner
 
 
+# optimizer name -> fused update op (ops/optimizer_ops.py). All state
+# tensors are zeros-initialized; Adam gets the python-optimizer bias
+# correction folded into a traced lr (optimizer.py Adam parity).
+_OPT_OPS = {
+    "sgd": lambda kw: ("sgd_mom_update" if kw.get("momentum")
+                       else "sgd_update"),
+    "adam": "adam_update",
+    "rmsprop": "rmsprop_update",
+    "rmspropalex": "rmspropalex_update",
+    "ftrl": "ftrl_update",
+    "signsgd": "signsgd_update",
+    "signum": "signum_update",
+    "ftml": "ftml_update",
+}
+
+
 class DataParallelTrainer:
     """Compile a full training step for a Symbol over a 1-D data mesh.
 
     Parameters are replicated; `data_names`/`label_names` inputs are sharded
-    on axis 0 over the mesh's `data` axis. The optimizer (sgd / sgd_mom) is
-    fused into the step. This is the engine under Module's multi-context
-    path and the dryrun_multichip driver hook.
+    on axis 0 over the mesh's `data` axis. The optimizer update (any op in
+    _OPT_OPS) is fused into the step; the learning rate and step count ride
+    as traced scalars so schedules never retrace. This is the fully-fused
+    engine behind bench.py and the dryrun_multichip driver hook.
     """
 
     def __init__(self, symbol, mesh, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
-                 loss_index=0):
+                 clip_gradient=None, loss_index=0, **opt_kwargs):
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ops.registry import get_op, AttrDict, OpCtx
 
         self._symbol = symbol
         self._mesh = mesh
@@ -44,24 +62,45 @@ class DataParallelTrainer:
         self._param_pos = [arg_names.index(n) for n in self._param_names]
         self._input_pos = [arg_names.index(n) for n in self._input_names]
         self._lr = float(learning_rate)
-        self._momentum = float(momentum)
-        self._wd = float(wd)
-        self._rescale = rescale_grad
         self._loss_index = loss_index
-        if optimizer not in ("sgd",):
+        self._t = 0
+
+        hp = dict(opt_kwargs)
+        if momentum:
+            hp["momentum"] = momentum
+        opt_op = _OPT_OPS.get(optimizer)
+        if opt_op is None:
             raise MXNetError(
                 f"DataParallelTrainer: fused optimizer {optimizer!r} not "
-                "supported (sgd/sgd-momentum); use Module+kvstore instead")
+                f"supported ({sorted(_OPT_OPS)}); use Module+kvstore for "
+                "host-updated optimizers")
+        opname = opt_op(hp) if callable(opt_op) else opt_op
+        schema = get_op(opname)
+        self._opt_schema = schema
+        # states = the op's aux inputs beyond (weight, grad)
+        self._n_states = len(schema.input_names) - 2
+        # built-in knobs are filtered to what the op takes; user opt_kwargs
+        # go through UNfiltered so parse_attrs fails fast on typos
+        attr_kwargs = {k: v for k, v in
+                       {"lr": self._lr, "wd": wd,
+                        "rescale_grad": 1.0 if rescale_grad is None
+                        else rescale_grad,
+                        "clip_gradient": clip_gradient,
+                        "t": 1 if "t" in schema.params else None}.items()
+                       if k in schema.params and v is not None}
+        attr_kwargs.update(hp)
+        attrs = schema.parse_attrs(attr_kwargs)
 
         run = _build_runner(symbol, is_train=True)
         n_args = len(arg_names)
         param_pos = list(self._param_pos)
         input_pos = list(self._input_pos)
-        lr, mom, wd = self._lr, self._momentum, self._wd
-        rescale = self._rescale
         loss_index = self._loss_index
+        fcompute = schema.fcompute
+        has_t = "t" in schema.params
+        is_adam = optimizer == "adam"
 
-        def step(params, momenta, aux, inputs, rng):
+        def step(params, states, aux, inputs, rng, lr, t):
             def loss_fn(params):
                 args = [None] * n_args
                 for p, v in zip(param_pos, params):
@@ -76,20 +115,21 @@ class DataParallelTrainer:
 
             (loss, (new_aux, outputs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            scale = rescale if rescale is not None else 1.0
-            new_params, new_momenta = [], []
-            for w, g, m in zip(params, grads, momenta):
-                g = g * jnp.asarray(scale, g.dtype) + \
-                    jnp.asarray(wd, w.dtype) * w
-                if mom != 0.0:
-                    m = jnp.asarray(mom, m.dtype) * m - \
-                        jnp.asarray(lr, w.dtype) * g
-                    w = w + m
-                else:
-                    w = w - jnp.asarray(lr, w.dtype) * g
-                new_params.append(w)
-                new_momenta.append(m)
-            return (tuple(new_params), tuple(new_momenta), new_aux, loss,
+            eff_lr = lr
+            if is_adam:  # python Adam's bias correction (optimizer.py)
+                b1, b2 = attrs["beta1"], attrs["beta2"]
+                eff_lr = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            a2 = AttrDict(attrs)
+            a2["lr"] = eff_lr
+            if has_t:
+                a2["t"] = t
+            octx = OpCtx(is_train=True)
+            new_params, new_states = [], []
+            for w, g, st in zip(params, grads, states):
+                res = fcompute(a2, octx, w, g, *st)
+                new_params.append(res[0])
+                new_states.append(tuple(res[1:]))
+            return (tuple(new_params), tuple(new_states), new_aux, loss,
                     outputs)
 
         repl = NamedSharding(mesh, P())
@@ -97,7 +137,7 @@ class DataParallelTrainer:
         self._repl, self._shard = repl, shard
         self._step = jax.jit(
             step,
-            in_shardings=(repl, repl, repl, shard, repl),
+            in_shardings=(repl, repl, repl, shard, repl, repl, repl),
             out_shardings=(repl, repl, repl, repl, shard),
             donate_argnums=(0, 1))
 
@@ -110,8 +150,10 @@ class DataParallelTrainer:
         return list(self._input_names)
 
     def init_state(self, shape_kwargs, initializer=None, seed=0):
-        """Infer shapes from input shapes; return (params, momenta, aux)
-        tuples of replicated jax arrays."""
+        """Infer shapes from input shapes; return (params, states, aux)
+        tuples of replicated jax arrays. `states` holds one tuple of
+        optimizer-state arrays per parameter (momenta for sgd, mean/var for
+        adam, ...)."""
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
         shapes = dict(zip(self._arg_names, arg_shapes))
         rng = _np.random.RandomState(seed)
@@ -128,15 +170,16 @@ class DataParallelTrainer:
                 v = rng.normal(0, 0.01, size=s).astype(_np.float32)
             # host numpy straight onto the mesh (see shard_inputs)
             params.append(jax.device_put(v, self._repl))
-        momenta = tuple(jax.device_put(_np.zeros(p.shape, p.dtype),
-                                       self._repl)
-                        for p in params)
+        states = tuple(
+            tuple(jax.device_put(_np.zeros(p.shape, p.dtype), self._repl)
+                  for _ in range(self._n_states))
+            for p in params)
         aux = tuple(jax.device_put(
             # moving variances start at 1 (MXNet BatchNorm aux parity)
             _np.ones(s, _np.float32) if n.endswith("moving_var")
             else _np.zeros(s, _np.float32), self._repl)
             for n, s in zip(self._aux_names, aux_shapes))
-        return tuple(params), momenta, aux
+        return tuple(params), states, aux
 
     def shard_inputs(self, arrays):
         """Commit host batch arrays to the mesh, sharded on axis 0.
@@ -153,11 +196,23 @@ class DataParallelTrainer:
             out.append(jax.device_put(a, self._shard))
         return tuple(out)
 
-    def step(self, params, momenta, aux, inputs, rng=None):
+    @property
+    def learning_rate(self):
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        """Schedules never retrace: lr is a traced input to the step."""
+        self._lr = float(lr)
+
+    def step(self, params, states, aux, inputs, rng=None):
         if rng is None:
             from .. import random as _random
             rng = _random.next_key()
         # the key may have been minted on the default backend; commit it to
         # the mesh so the step never mixes platforms
         rng = jax.device_put(rng, self._repl)
-        return self._step(params, momenta, aux, inputs, rng)
+        self._t += 1
+        # host numpy scalars: jit commits them per in_shardings (never the
+        # default backend — see shard_inputs)
+        return self._step(params, states, aux, inputs, rng,
+                          _np.float32(self._lr), _np.float32(self._t))

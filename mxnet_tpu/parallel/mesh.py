@@ -34,3 +34,58 @@ def data_parallel_mesh(n=None, devices=None):
     if n is None:
         n = len(devices)
     return build_mesh({"data": n}, devices)
+
+
+# One canonical mesh per device tuple so Parameters, Module executors and
+# split_and_load all agree on the mesh object (shardings compare equal).
+_MESH_CACHE: dict = {}
+
+
+def mesh_for_devices(devices):
+    key = tuple(devices)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = data_parallel_mesh(len(devices), list(devices))
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def mesh_for_contexts(ctx_list):
+    """The cached 1-D data mesh over the jax devices of a context list —
+    the TPU-native meaning of ctx=[gpu(0)..gpu(n-1)] everywhere a context
+    list is accepted (Module, gluon initialize/split_and_load)."""
+    return mesh_for_devices([c.jax_device() for c in ctx_list])
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, batch_axis=0):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = [None] * batch_axis + [mesh.axis_names[0]]
+    return NamedSharding(mesh, P(*spec))
+
+
+def put_replicated(data, mesh):
+    """Commit host/any-device data to the mesh, replicated."""
+    import jax
+    data = getattr(data, "_data", data)
+    if not isinstance(data, jax.Array):
+        data = np.asarray(data)
+    return jax.device_put(data, replicated_sharding(mesh))
+
+
+def put_batch_sharded(data, mesh, batch_axis=0):
+    """Commit host/any-device data to the mesh, sharded on the batch axis."""
+    import jax
+    data = getattr(data, "_data", data)
+    if not isinstance(data, jax.Array):
+        data = np.asarray(data)
+    n = mesh.devices.size
+    if data.shape[batch_axis] % n != 0:
+        raise ValueError(
+            f"batch axis {batch_axis} of shape {tuple(data.shape)} must be "
+            f"divisible by the {n}-device mesh")
+    return jax.device_put(data, batch_sharding(mesh, batch_axis))

@@ -394,9 +394,11 @@ class Symbol:
     # -- binding ------------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, mesh=None,
+                    sharded_args=(), **kwargs):
         from ..executor import Executor
-        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
+                                     mesh=mesh, sharded_args=sharded_args)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

@@ -348,6 +348,13 @@ def test_split_and_load_clip_global_norm():
     norm = gluon.utils.clip_global_norm(arrs, 1.0)
     total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
     assert abs(total - 1.0) < 1e-5
+    # multi-ctx: ONE batch-sharded array over the ctxs' mesh (TPU-native DP)
     parts = gluon.utils.split_and_load(np.arange(12).reshape(6, 2),
                                        [mx.cpu(0), mx.cpu(1)])
-    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    assert len(parts) == 1 and parts[0].shape == (6, 2)
+    import jax
+    assert len(parts[0]._data.sharding.device_set) == 2
+    # single-ctx keeps reference behavior
+    parts1 = gluon.utils.split_and_load(np.arange(12).reshape(6, 2),
+                                        [mx.cpu(0)])
+    assert len(parts1) == 1 and parts1[0].shape == (6, 2)
