@@ -175,8 +175,9 @@ class DataParallelTrainer:
                   for _ in range(self._n_states))
             for p in params)
         aux = tuple(jax.device_put(
-            # moving variances start at 1 (MXNet BatchNorm aux parity)
-            _np.ones(s, _np.float32) if n.endswith("moving_var")
+            # moving/running variances start at 1 (MXNet BatchNorm parity)
+            _np.ones(s, _np.float32)
+            if n.endswith(("moving_var", "running_var"))
             else _np.zeros(s, _np.float32), self._repl)
             for n, s in zip(self._aux_names, aux_shapes))
         return tuple(params), states, aux
@@ -203,6 +204,16 @@ class DataParallelTrainer:
     def set_learning_rate(self, lr):
         """Schedules never retrace: lr is a traced input to the step."""
         self._lr = float(lr)
+
+    def replicate_inputs(self, arrays):
+        """Commit host arrays to the mesh, replicated (e.g. eval inputs)."""
+        out = []
+        for a in arrays:
+            a = getattr(a, "_data", a)
+            if not isinstance(a, jax.Array):
+                a = _np.asarray(a)
+            out.append(jax.device_put(a, self._repl))
+        return tuple(out)
 
     def step(self, params, states, aux, inputs, rng=None):
         if rng is None:

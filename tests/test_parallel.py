@@ -216,5 +216,5 @@ def test_entry_hook_compiles():
     import __graft_entry__ as ge
     fn, example_args = ge.entry()
     out = jax.jit(fn)(*example_args)
-    assert out.shape == (64, 10)
-    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+    assert out.shape == (32, 1000)  # flagship: ResNet-50 inference b32
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-3)
