@@ -49,3 +49,4 @@ from . import parallel
 from . import image
 from . import gluon
 from . import rnn
+from . import test_utils

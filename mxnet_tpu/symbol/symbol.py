@@ -243,6 +243,10 @@ class Symbol:
     def __pow__(self, o):
         return self._binop(o, "broadcast_power", "_power_scalar")
 
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar",
+                           reverse=True)
+
     def __neg__(self):
         return self._binop(-1.0, None, "_mul_scalar")
 

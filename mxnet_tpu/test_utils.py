@@ -1,0 +1,324 @@
+"""Correctness-harness utilities.
+
+Parity target: python/mxnet/test_utils.py (SURVEY.md §4) — the reference's
+four-tier correctness net: `assert_almost_equal` (:470),
+`check_numeric_gradient` (:792), `check_symbolic_forward/backward` (:925),
+`check_consistency` (:1207, the de-facto backend-parity harness). Here the
+backend pair is CPU-jax vs TPU-jax (one XLA compiler, two targets) instead of
+the reference's hand-written CPU kernels vs CUDA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "random_arrays",
+           "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+           "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "simple_forward"]
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def find_max_violation(a, b, rtol, atol):
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    viol = diff - tol
+    idx = np.unravel_index(np.argmax(viol), viol.shape) if viol.size else ()
+    return idx, (diff[idx] / (atol + rtol * np.abs(b[idx]))
+                 if viol.size else 0.0)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _to_np(a), _to_np(b)
+    if a.shape != b.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{a.shape} vs {names[1]}{b.shape}")
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        idx, rel = find_max_violation(a, b, rtol, atol)
+        raise AssertionError(
+            f"Error {rel:.6g} exceeds tolerance rtol={rtol}, atol={atol} at "
+            f"position {idx}: {names[0]}={a[idx] if idx else a}, "
+            f"{names[1]}={b[idx] if idx else b}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None,
+                 scale=1.0):
+    if stype != "default":
+        from .ndarray import sparse as sp
+        dense = np.random.uniform(-scale, scale, size=shape)
+        if density is not None:
+            mask = np.random.uniform(size=shape) < density
+            dense = dense * mask
+        arr = nd_array(dense.astype(dtype or "float32"), ctx=ctx)
+        return arr.tostype(stype) if hasattr(arr, "tostype") else arr
+    return nd_array(np.random.uniform(-scale, scale, size=shape)
+                    .astype(dtype or "float32"), ctx=ctx)
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype("float32") if s else
+              np.float32(np.random.randn()) for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+def _parse_location(sym, location, ctx):
+    """location: dict name->array or list in list_arguments() order."""
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        loc = {k: v for k, v in location.items()}
+    else:
+        loc = dict(zip(arg_names, location))
+    out = {}
+    for k, v in loc.items():
+        out[k] = v if isinstance(v, NDArray) else nd_array(
+            np.asarray(v), ctx=ctx)
+    return out
+
+
+def _parse_aux(sym, aux_states, ctx):
+    aux_names = sym.list_auxiliary_states()
+    if aux_states is None:
+        return None
+    if isinstance(aux_states, dict):
+        d = aux_states
+    else:
+        d = dict(zip(aux_names, aux_states))
+    return {k: v if isinstance(v, NDArray) else nd_array(np.asarray(v),
+                                                         ctx=ctx)
+            for k, v in d.items()}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Run forward on numpy inputs, return numpy outputs."""
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, inputs, ctx)
+    exe = sym.bind(ctx, loc)
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-20,
+                           aux_states=None, ctx=None, equal_nan=False):
+    """Forward outputs must match `expected` (list or dict by output name).
+
+    Parity: test_utils.py:925."""
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    exe = sym.bind(ctx, loc, aux_states=aux)
+    outputs = exe.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[n] for n in sym.list_outputs()]
+    for out, exp, name in zip(outputs, expected, sym.list_outputs()):
+        assert_almost_equal(out.asnumpy(), np.asarray(exp), rtol, atol,
+                            names=(name, "expected"), equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-20, aux_states=None, grad_req="write",
+                            ctx=None, equal_nan=False):
+    """Backward grads must match `expected` (dict name->array).
+
+    Parity: test_utils.py:987."""
+    from .ndarray.ndarray import zeros_like
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    if isinstance(grad_req, str):
+        reqs = {n: grad_req for n in sym.list_arguments()}
+    else:
+        reqs = dict(grad_req)
+    grads = {n: zeros_like(loc[n]) for n in loc if reqs.get(n) != "null"}
+    exe = sym.bind(ctx, loc, args_grad=grads, grad_req=reqs, aux_states=aux)
+    exe.forward(is_train=True)
+    ogs = [g if isinstance(g, NDArray) else nd_array(np.asarray(g), ctx=ctx)
+           for g in (out_grads if isinstance(out_grads, (list, tuple))
+                     else [out_grads])]
+    exe.backward(out_grads=ogs)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    for name, exp in expected.items():
+        if exp is None:
+            continue
+        assert_almost_equal(exe.grad_dict[name].asnumpy(), np.asarray(exp),
+                            rtol, atol, names=(f"grad({name})", "expected"),
+                            equal_nan=equal_nan)
+    return {n: g.asnumpy() for n, g in exe.grad_dict.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None,
+                           use_forward_train=True):
+    """Analytic (vjp) gradients must match central finite differences of a
+    random scalar projection of the outputs. Parity: test_utils.py:792.
+
+    Keep input shapes small: the numeric side runs 2 forwards per element.
+    """
+    from .ndarray.ndarray import zeros_like
+    ctx = ctx or default_context()
+    atol = atol if atol is not None else rtol * 1e-2
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    arg_names = sym.list_arguments()
+    if grad_nodes is None:
+        grad_nodes = [n for n in arg_names
+                      if np.issubdtype(loc[n].dtype, np.floating)]
+
+    reqs = {n: ("write" if n in grad_nodes else "null") for n in arg_names}
+    grads = {n: zeros_like(loc[n]) for n in grad_nodes}
+    exe = sym.bind(ctx, loc, args_grad=grads, grad_req=reqs, aux_states=aux)
+    outputs = exe.forward(is_train=use_forward_train)
+    # fixed random projection -> scalar objective sum(out * proj)
+    rng = np.random.RandomState(42)
+    projs = [rng.normal(0, 1, size=o.shape).astype(np.float64)
+             for o in outputs]
+    ogs = [nd_array(p.astype("float32"), ctx=ctx) for p in projs]
+    exe.backward(out_grads=ogs)
+    analytic = {n: exe.grad_dict[n].asnumpy().astype(np.float64)
+                for n in grad_nodes}
+
+    base_np = {n: loc[n].asnumpy().astype(np.float64) for n in arg_names}
+    aux_np = {k: v.asnumpy() for k, v in (aux or {}).items()} or None
+
+    # ONE executor reused across all probes: forward(**kwargs) swaps inputs
+    # without recompiling (2*numel forwards would otherwise each re-trace)
+    loc2 = {n: nd_array(base_np[n].astype("float32"), ctx=ctx)
+            for n in arg_names}
+    aux2 = ({k: nd_array(v, ctx=ctx) for k, v in aux_np.items()}
+            if aux_np else None)
+    exe2 = sym.bind(ctx, loc2, aux_states=aux2)
+
+    def objective(vals):
+        if aux_np:  # is_train forwards may advance aux (BN stats): reset
+            for k, v in aux_np.items():
+                exe2.aux_dict[k][:] = v
+        outs = exe2.forward(is_train=use_forward_train,
+                            **{n: vals[n].astype("float32")
+                               for n in arg_names})
+        return sum(float((o.asnumpy().astype(np.float64) * p).sum())
+                   for o, p in zip(outs, projs))
+
+    for name in grad_nodes:
+        v = base_np[name]
+        num = np.zeros_like(v)
+        flat = v.reshape(-1)
+        numf = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            fp = objective(base_np)
+            flat[i] = orig - numeric_eps
+            fm = objective(base_np)
+            flat[i] = orig
+            numf[i] = (fp - fm) / (2 * numeric_eps)
+        scale = max(1.0, np.abs(num).max())
+        assert_almost_equal(analytic[name] / scale, num / scale,
+                            rtol, atol,
+                            names=(f"analytic({name})", f"numeric({name})"))
+    return analytic
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4,
+                      grad_req="write", arg_params=None, aux_params=None,
+                      raise_on_err=True):
+    """Run the SAME symbol under every ctx config and cross-check outputs
+    and gradients — the backend-parity net (test_utils.py:1207; reference
+    pattern: CPU kernels vs CUDA; here CPU-jax vs TPU-jax).
+
+    ctx_list entries: {'ctx': Context, <input name>: shape, ...,
+    optional 'type_dict': {name: dtype}}.
+    """
+    from .ndarray.ndarray import zeros_like
+    assert len(ctx_list) > 1
+    tmpl = ctx_list[0]
+    arg_names = sym.list_arguments()
+
+    rng = np.random.RandomState(0)
+    shapes = {k: v for k, v in tmpl.items() if k not in ("ctx", "type_dict")}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    base_args = {n: (arg_params[n] if arg_params and n in arg_params else
+                     rng.normal(0, scale, size=s))
+                 for n, s in zip(arg_names, arg_shapes)}
+    base_aux = {n: (aux_params[n] if aux_params and n in aux_params else
+                    np.ones(s) if n.endswith(("moving_var", "running_var"))
+                    else np.zeros(s))
+                for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    out0 = None
+    proj = None
+    results = []
+    for cfg in ctx_list:
+        ctx = cfg["ctx"]
+        tdict = cfg.get("type_dict", {})
+        loc = {n: nd_array(np.asarray(base_args[n]).astype(
+            tdict.get(n, "float32")), ctx=ctx) for n in arg_names}
+        aux = {n: nd_array(np.asarray(v).astype("float32"), ctx=ctx)
+               for n, v in base_aux.items()} or None
+        grads = {n: zeros_like(loc[n]) for n in arg_names
+                 if grad_req != "null"}
+        exe = sym.bind(ctx, loc, args_grad=grads or None,
+                       grad_req=grad_req, aux_states=aux)
+        outputs = exe.forward(is_train=(grad_req != "null"))
+        if proj is None:
+            proj = [np.random.RandomState(7).normal(size=o.shape)
+                    .astype("float32") for o in outputs]
+        if grad_req != "null":
+            exe.backward(out_grads=[nd_array(p, ctx=ctx) for p in proj])
+        res = {"out": [o.asnumpy().astype(np.float64) for o in outputs],
+               "grad": {n: g.asnumpy().astype(np.float64)
+                        for n, g in exe.grad_dict.items()}}
+        results.append(res)
+
+    ref = results[0]
+    for i, res in enumerate(results[1:], 1):
+        for o_ref, o, name in zip(ref["out"], res["out"],
+                                  sym.list_outputs()):
+            assert_almost_equal(o, o_ref, rtol, atol,
+                                names=(f"ctx{i}:{name}", f"ctx0:{name}"))
+        for n in ref["grad"]:
+            assert_almost_equal(res["grad"][n], ref["grad"][n], rtol, atol,
+                                names=(f"ctx{i}:grad({n})",
+                                       f"ctx0:grad({n})"))
+    return results

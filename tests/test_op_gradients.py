@@ -1,0 +1,425 @@
+"""Numeric-gradient sweep over the operator surface.
+
+Role of the reference's check_numeric_gradient coverage in
+tests/python/unittest/test_operator.py (SURVEY.md §4 tier a): every
+differentiable op family is checked against central finite differences of a
+random projection of its outputs. Shapes are tiny — the numeric side runs
+2*numel forwards per input.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward,
+                                  check_consistency)
+
+
+def _v(name="data"):
+    return mx.sym.Variable(name)
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _interior(shape, lo=-0.8, hi=0.8, seed=0):
+    return _rs(seed).uniform(lo, hi, size=shape).astype(np.float32)
+
+
+def _pos(shape, lo=0.3, hi=2.0, seed=0):
+    return _rs(seed).uniform(lo, hi, size=shape).astype(np.float32)
+
+
+def _away_zero(shape, seed=0):
+    x = _rs(seed).uniform(0.4, 1.5, size=shape).astype(np.float32)
+    return x * np.where(_rs(seed + 1).rand(*shape) < 0.5, -1, 1)
+
+
+def _any(shape, seed=0):
+    return _rs(seed).normal(0, 1, size=shape).astype(np.float32)
+
+
+S = (2, 3)
+
+# (id, symbol builder, {input: value}) — builder gets the input Variables
+UNARY_CASES = [
+    ("abs", lambda d: mx.sym.abs(d), _away_zero(S)),
+    ("exp", lambda d: mx.sym.exp(d), _any(S)),
+    ("log", lambda d: mx.sym.log(d), _pos(S)),
+    ("log2", lambda d: mx.sym.log2(d), _pos(S)),
+    ("log10", lambda d: mx.sym.log10(d), _pos(S)),
+    ("log1p", lambda d: mx.sym.log1p(d), _pos(S)),
+    ("expm1", lambda d: mx.sym.expm1(d), _interior(S)),
+    ("sqrt", lambda d: mx.sym.sqrt(d), _pos(S)),
+    ("rsqrt", lambda d: mx.sym.rsqrt(d), _pos(S)),
+    ("cbrt", lambda d: mx.sym.cbrt(d), _pos(S)),
+    ("rcbrt", lambda d: mx.sym.rcbrt(d), _pos(S)),
+    ("square", lambda d: mx.sym.square(d), _any(S)),
+    ("reciprocal", lambda d: mx.sym.reciprocal(d), _away_zero(S)),
+    ("negative", lambda d: mx.sym.negative(d), _any(S)),
+    ("sigmoid", lambda d: mx.sym.sigmoid(d), _any(S)),
+    ("tanh", lambda d: mx.sym.tanh(d), _any(S)),
+    ("softsign", lambda d: mx.sym.softsign(d), _any(S)),
+    ("relu", lambda d: mx.sym.relu(d), _away_zero(S)),
+    ("sin", lambda d: mx.sym.sin(d), _any(S)),
+    ("cos", lambda d: mx.sym.cos(d), _any(S)),
+    ("tan", lambda d: mx.sym.tan(d), _interior(S, -0.5, 0.5)),
+    ("arcsin", lambda d: mx.sym.arcsin(d), _interior(S)),
+    ("arccos", lambda d: mx.sym.arccos(d), _interior(S)),
+    ("arctan", lambda d: mx.sym.arctan(d), _any(S)),
+    ("sinh", lambda d: mx.sym.sinh(d), _interior(S)),
+    ("cosh", lambda d: mx.sym.cosh(d), _interior(S)),
+    ("arcsinh", lambda d: mx.sym.arcsinh(d), _any(S)),
+    ("arccosh", lambda d: mx.sym.arccosh(d), _pos(S, 1.3, 2.5)),
+    ("arctanh", lambda d: mx.sym.arctanh(d), _interior(S)),
+    ("erf", lambda d: mx.sym.erf(d), _any(S)),
+    ("erfinv", lambda d: mx.sym.erfinv(d), _interior(S)),
+    ("gamma", lambda d: mx.sym.gamma(d), _pos(S, 1.0, 2.0)),
+    ("gammaln", lambda d: mx.sym.gammaln(d), _pos(S, 1.0, 2.0)),
+    ("smooth_l1", lambda d: mx.sym.smooth_l1(d, scalar=1.0), _away_zero(S)),
+    ("clip", lambda d: mx.sym.clip(d, a_min=-0.5, a_max=0.5),
+     _away_zero(S)),
+    ("plus_scalar", lambda d: d + 2.5, _any(S)),
+    ("mul_scalar", lambda d: d * 3.0, _any(S)),
+    ("rdiv_scalar", lambda d: 2.0 / d, _away_zero(S)),
+    ("power_scalar", lambda d: d ** 2.0, _pos(S)),
+    ("rpower_scalar", lambda d: 2.0 ** d, _interior(S)),
+]
+
+
+@pytest.mark.parametrize("case", UNARY_CASES, ids=lambda c: c[0])
+def test_unary_gradient(case):
+    name, builder, x = case
+    sym = builder(_v())
+    check_numeric_gradient(sym, {"data": x}, rtol=5e-2, atol=1e-3)
+
+
+BINARY_CASES = [
+    ("elemwise_add", lambda a, b: a + b, _any(S, 1), _any(S, 2)),
+    ("elemwise_sub", lambda a, b: a - b, _any(S, 1), _any(S, 2)),
+    ("elemwise_mul", lambda a, b: a * b, _any(S, 1), _any(S, 2)),
+    ("elemwise_div", lambda a, b: a / b, _any(S, 1), _away_zero(S, 2)),
+    ("broadcast_add", lambda a, b: mx.sym.broadcast_add(a, b),
+     _any(S, 1), _any((1, 3), 2)),
+    ("broadcast_mul", lambda a, b: mx.sym.broadcast_mul(a, b),
+     _any(S, 1), _any((2, 1), 2)),
+    ("broadcast_div", lambda a, b: mx.sym.broadcast_div(a, b),
+     _any(S, 1), _away_zero((1, 3), 2)),
+    ("broadcast_sub", lambda a, b: mx.sym.broadcast_sub(a, b),
+     _any(S, 1), _any((1, 3), 2)),
+    ("broadcast_maximum", lambda a, b: mx.sym.broadcast_maximum(a, b),
+     _any(S, 1), _any((1, 3), 2)),
+    ("broadcast_minimum", lambda a, b: mx.sym.broadcast_minimum(a, b),
+     _any(S, 1), _any((1, 3), 2)),
+    ("broadcast_power", lambda a, b: mx.sym.broadcast_power(a, b),
+     _pos(S, 1), _interior((1, 3), 1.0, seed=2)),
+    ("broadcast_hypot", lambda a, b: mx.sym.broadcast_hypot(a, b),
+     _away_zero(S, 1), _away_zero((1, 3), 2)),
+    ("dot", lambda a, b: mx.sym.dot(a, b), _any((2, 3), 1), _any((3, 4), 2)),
+    ("batch_dot", lambda a, b: mx.sym.batch_dot(a, b),
+     _any((2, 2, 3), 1), _any((2, 3, 2), 2)),
+    ("where", lambda a, b: mx.sym.where(
+        mx.sym.Variable("cond"), a, b), _any(S, 1), _any(S, 2)),
+]
+
+
+@pytest.mark.parametrize("case", BINARY_CASES, ids=lambda c: c[0])
+def test_binary_gradient(case):
+    name, builder, a, b = case
+    lhs, rhs = mx.sym.Variable("lhs"), mx.sym.Variable("rhs")
+    sym = builder(lhs, rhs)
+    loc = {"lhs": a, "rhs": b}
+    grad_nodes = ["lhs", "rhs"]
+    if name == "where":
+        loc["cond"] = (np.arange(6).reshape(S) % 2).astype(np.float32)
+        grad_nodes = ["lhs", "rhs"]
+    check_numeric_gradient(sym, loc, rtol=5e-2, atol=1e-3,
+                           grad_nodes=grad_nodes)
+
+
+REDUCE_CASES = [
+    ("sum", lambda d: mx.sym.sum(d), {}),
+    ("sum_axis", lambda d: mx.sym.sum(d, axis=1), {}),
+    ("mean", lambda d: mx.sym.mean(d, axis=0), {}),
+    ("max", lambda d: mx.sym.max(d, axis=1), {}),
+    ("min", lambda d: mx.sym.min(d, axis=1), {}),
+    ("prod", lambda d: mx.sym.prod(d, axis=1), {}),
+    ("nansum", lambda d: mx.sym.nansum(d, axis=1), {}),
+    ("norm", lambda d: mx.sym.norm(d), {}),
+]
+
+
+@pytest.mark.parametrize("case", REDUCE_CASES, ids=lambda c: c[0])
+def test_reduce_gradient(case):
+    name, builder, _ = case
+    # distinct magnitudes so max/min have unique argmax (numeric-safe)
+    x = (np.arange(1, 7).reshape(S) * 0.37 + 0.1).astype(np.float32)
+    sym = builder(_v())
+    check_numeric_gradient(sym, {"data": x}, rtol=5e-2, atol=1e-3)
+
+
+SHAPE_CASES = [
+    ("transpose", lambda d: mx.sym.transpose(d, axes=(1, 0)), S),
+    ("reshape", lambda d: mx.sym.Reshape(d, shape=(3, 2)), S),
+    ("expand_dims", lambda d: mx.sym.expand_dims(d, axis=1), S),
+    ("squeeze", lambda d: mx.sym.squeeze(d), (2, 1, 3)),
+    ("tile", lambda d: mx.sym.tile(d, reps=(2, 2)), S),
+    ("repeat", lambda d: mx.sym.repeat(d, repeats=2, axis=1), S),
+    ("reverse", lambda d: mx.sym.reverse(d, axis=1), S),
+    ("slice", lambda d: mx.sym.slice(d, begin=(0, 1), end=(2, 3)), S),
+    ("slice_axis", lambda d: mx.sym.slice_axis(d, axis=1, begin=0, end=2), S),
+    ("flatten", lambda d: mx.sym.Flatten(d), (2, 3, 2)),
+    ("swapaxis", lambda d: mx.sym.SwapAxis(d, dim1=0, dim2=1), S),
+    ("pad", lambda d: mx.sym.Pad(d, mode="constant",
+                                 pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     (1, 1, 3, 3)),
+    ("broadcast_to", lambda d: mx.sym.broadcast_to(d, shape=(2, 3)), (1, 3)),
+    ("broadcast_axis", lambda d: mx.sym.broadcast_axis(d, axis=0, size=2),
+     (1, 3)),
+    ("depth_to_space", lambda d: mx.sym.depth_to_space(d, block_size=2),
+     (1, 4, 2, 2)),
+    ("space_to_depth", lambda d: mx.sym.space_to_depth(d, block_size=2),
+     (1, 1, 4, 4)),
+    ("diag", lambda d: mx.sym.diag(d), (3, 3)),
+    ("stack", lambda d: mx.sym.stack(d, d, axis=0), S),
+    ("slicechannel", lambda d: mx.sym.SliceChannel(
+        d, num_outputs=3, axis=1)[0], S),
+]
+
+
+@pytest.mark.parametrize("case", SHAPE_CASES, ids=lambda c: c[0])
+def test_shape_op_gradient(case):
+    name, builder, shape = case
+    sym = builder(_v())
+    check_numeric_gradient(sym, {"data": _any(shape)}, rtol=5e-2, atol=1e-3)
+
+
+def test_concat_gradient():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    sym = mx.sym.Concat(a, b, dim=1)
+    check_numeric_gradient(sym, {"a": _any(S, 1), "b": _any((2, 2), 2)},
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_add_n_gradient():
+    a, b, c = (mx.sym.Variable(n) for n in "abc")
+    sym = mx.sym.add_n(a, b, c)
+    check_numeric_gradient(sym, {"a": _any(S, 1), "b": _any(S, 2),
+                                 "c": _any(S, 3)}, rtol=5e-2, atol=1e-3)
+
+
+NN_CASES = [
+    ("FullyConnected",
+     lambda d: mx.sym.FullyConnected(d, num_hidden=4, name="fc"),
+     {"data": _any((2, 3))}),
+    ("FullyConnected_nobias",
+     lambda d: mx.sym.FullyConnected(d, num_hidden=4, no_bias=True,
+                                     name="fc"),
+     {"data": _any((2, 3))}),
+    ("Convolution",
+     lambda d: mx.sym.Convolution(d, kernel=(2, 2), num_filter=2,
+                                  name="conv"),
+     {"data": _any((1, 2, 4, 4))}),
+    ("Convolution_stride_pad",
+     lambda d: mx.sym.Convolution(d, kernel=(3, 3), stride=(2, 2),
+                                  pad=(1, 1), num_filter=2, name="conv"),
+     {"data": _any((1, 2, 5, 5))}),
+    ("Deconvolution",
+     lambda d: mx.sym.Deconvolution(d, kernel=(2, 2), num_filter=2,
+                                    name="deconv"),
+     {"data": _any((1, 2, 3, 3))}),
+    ("Pooling_max",
+     lambda d: mx.sym.Pooling(d, pool_type="max", kernel=(2, 2),
+                              stride=(2, 2)),
+     {"data": _any((1, 1, 4, 4)) * 3}),
+    ("Pooling_avg",
+     lambda d: mx.sym.Pooling(d, pool_type="avg", kernel=(2, 2),
+                              stride=(2, 2)),
+     {"data": _any((1, 1, 4, 4))}),
+    ("LayerNorm",
+     lambda d: mx.sym.LayerNorm(d, name="ln"),
+     {"data": _any((2, 4))}),
+    ("InstanceNorm",
+     lambda d: mx.sym.InstanceNorm(d, name="in"),
+     {"data": _any((2, 2, 4))}),
+    ("L2Normalization",
+     lambda d: mx.sym.L2Normalization(d),
+     {"data": _away_zero((2, 4))}),
+    ("LRN",
+     lambda d: mx.sym.LRN(d, nsize=3),
+     {"data": _any((1, 4, 3, 3))}),
+    ("softmax", lambda d: mx.sym.softmax(d, axis=1), {"data": _any(S)}),
+    ("log_softmax", lambda d: mx.sym.log_softmax(d, axis=1),
+     {"data": _any(S)}),
+    ("SoftmaxActivation", lambda d: mx.sym.SoftmaxActivation(d),
+     {"data": _any(S)}),
+    ("Activation_softrelu",
+     lambda d: mx.sym.Activation(d, act_type="softrelu"),
+     {"data": _any(S)}),
+    ("LeakyReLU_leaky",
+     lambda d: mx.sym.LeakyReLU(d, act_type="leaky", slope=0.1),
+     {"data": _away_zero(S)}),
+    ("LeakyReLU_elu",
+     lambda d: mx.sym.LeakyReLU(d, act_type="elu", slope=0.3),
+     {"data": _away_zero(S)}),
+    ("UpSampling",
+     lambda d: mx.sym.UpSampling(d, scale=2, sample_type="nearest"),
+     {"data": _any((1, 1, 2, 2))}),
+]
+
+
+@pytest.mark.parametrize("case", NN_CASES, ids=lambda c: c[0])
+def test_nn_gradient(case):
+    name, builder, loc = case
+    sym = builder(_v())
+    arg_shapes = {k: v.shape for k, v in loc.items()}
+    full_args = sym.list_arguments()
+    arg_s, _, _ = sym.infer_shape(**arg_shapes)
+    full_loc = dict(loc)
+    for n, s in zip(full_args, arg_s):
+        if n not in full_loc:
+            full_loc[n] = _any(s, seed=hash(n) % 1000)
+    grad_nodes = [n for n in full_args if n != "label"]
+    check_numeric_gradient(sym, full_loc, rtol=5e-2, atol=2e-3,
+                           grad_nodes=grad_nodes)
+
+
+def test_batchnorm_gradient():
+    sym = mx.sym.BatchNorm(_v(), name="bn", fix_gamma=False)
+    x = _any((2, 3, 2, 2))
+    gamma = _pos((3,), 0.5, 1.5)
+    beta = _any((3,), 5)
+    aux = {"bn_moving_mean": np.zeros(3, np.float32),
+           "bn_moving_var": np.ones(3, np.float32)}
+    check_numeric_gradient(
+        sym, {"data": x, "bn_gamma": gamma, "bn_beta": beta},
+        aux_states=aux, rtol=6e-2, atol=3e-3)
+
+
+def test_embedding_take_gradient():
+    # Embedding: grad w.r.t. weight only (indices are integral)
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("weight")
+    sym = mx.sym.Embedding(data, w, input_dim=5, output_dim=3)
+    idx = np.array([[0, 2], [4, 1]], np.float32)
+    check_numeric_gradient(sym, {"data": idx, "weight": _any((5, 3))},
+                           grad_nodes=["weight"], rtol=5e-2, atol=1e-3)
+
+    a = mx.sym.Variable("a")
+    sym = mx.sym.take(a, mx.sym.Variable("idx"))
+    check_numeric_gradient(sym, {"a": _any((4, 3)),
+                                 "idx": np.array([1, 3], np.float32)},
+                           grad_nodes=["a"], rtol=5e-2, atol=1e-3)
+
+
+def test_gather_pick_gradient():
+    a = mx.sym.Variable("a")
+    sym = mx.sym.pick(a, mx.sym.Variable("idx"), axis=1)
+    check_numeric_gradient(sym, {"a": _any((3, 4)),
+                                 "idx": np.array([0, 2, 3], np.float32)},
+                           grad_nodes=["a"], rtol=5e-2, atol=1e-3)
+    sym = mx.sym.gather_nd(a, mx.sym.Variable("idx"))
+    check_numeric_gradient(
+        sym, {"a": _any((3, 4)),
+              "idx": np.array([[0, 2], [1, 3]], np.float32)},
+        grad_nodes=["a"], rtol=5e-2, atol=1e-3)
+
+
+def test_linalg_gradient():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    sym = mx.sym._linalg_gemm2(a, b)
+    check_numeric_gradient(sym, {"a": _any((2, 3)), "b": _any((3, 2))},
+                           rtol=5e-2, atol=1e-3)
+    spd = np.array([[2.0, 0.5], [0.5, 1.5]], np.float32)
+    sym = mx.sym._linalg_sumlogdiag(mx.sym._linalg_potrf(a))
+    check_numeric_gradient(sym, {"a": spd}, rtol=5e-2, atol=1e-3)
+
+
+def test_softmax_output_custom_grad():
+    """SoftmaxOutput's backward is the training grad (p - onehot), NOT the
+    derivative of its forward — check against the closed form
+    (softmax_output.cc semantics)."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data, label, name="softmax")
+    x = _any((3, 4))
+    y = np.array([1, 0, 3], np.float32)
+    e = np.exp(x - x.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    check_symbolic_forward(sym, {"data": x, "label": y}, [p], rtol=1e-4,
+                           atol=1e-5)
+    onehot = np.eye(4, dtype=np.float32)[y.astype(int)]
+    check_symbolic_backward(sym, {"data": x, "label": y},
+                            [np.ones_like(p)], {"data": p - onehot},
+                            rtol=1e-4, atol=1e-5,
+                            grad_req={"data": "write", "label": "null"})
+
+
+def test_regression_output_custom_grads():
+    """Regression heads backward with (pred - label)-style training grads,
+    not the derivative of their (identity/sigmoid) forward
+    (regression_output-inl.h semantics)."""
+    data, label = mx.sym.Variable("data"), mx.sym.Variable("label")
+    x, y = _any(S), _any(S, 9)
+    req = {"data": "write", "label": "null"}
+    n = S[1]  # reference normalizes by outputs/sample
+    # (regression_output-inl.h:200-206: grad_scale/num_output)
+    check_symbolic_backward(
+        mx.sym.LinearRegressionOutput(data, label), {"data": x, "label": y},
+        [np.ones(S, np.float32)], {"data": (x - y) / n}, rtol=1e-4,
+        atol=1e-5, grad_req=req)
+    p = 1 / (1 + np.exp(-x))
+    check_symbolic_backward(
+        mx.sym.LogisticRegressionOutput(data, label),
+        {"data": x, "label": y},
+        [np.ones(S, np.float32)], {"data": (p - y) / n}, rtol=1e-4,
+        atol=1e-5, grad_req=req)
+    check_symbolic_backward(
+        mx.sym.MAERegressionOutput(data, label), {"data": x, "label": y},
+        [np.ones(S, np.float32)], {"data": np.sign(x - y) / n}, rtol=1e-4,
+        atol=1e-5, grad_req=req)
+
+
+def test_makeloss_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.MakeLoss(mx.sym.square(data))
+    x = _any(S)
+    check_symbolic_backward(sym, {"data": x}, [np.ones(S, np.float32)],
+                            {"data": 2 * x}, rtol=1e-4, atol=1e-5)
+
+
+def test_check_consistency_smoke():
+    """cpu-vs-cpu degenerate consistency run (the TPU lane in tests_tpu/
+    runs the real cpu-vs-tpu pairing)."""
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    check_consistency(sym, [{"ctx": mx.cpu(0), "data": (2, 3)},
+                            {"ctx": mx.cpu(1), "data": (2, 3)}])
+
+
+def test_check_numeric_gradient_catches_wrong_grad():
+    """The harness itself must fail on a wrong gradient."""
+    from mxnet_tpu.ops.registry import register
+    import jax.numpy as jnp
+
+    def bad(attrs, octx, x):
+        import jax
+        @jax.custom_vjp
+        def f(x):
+            return jnp.sin(x)
+        f.defvjp(lambda x: (jnp.sin(x), x),
+                 lambda res, g: (g * 2.0,))  # wrong: should be cos(x)*g
+        return (f(x),)
+
+    try:
+        register("_test_bad_grad", bad, inputs=("data",))
+    except mx.base.MXNetError:
+        pass  # already registered in this session
+    data = mx.sym.Variable("data")
+    sym = getattr(mx.sym, "_test_bad_grad")(data)
+    with pytest.raises(AssertionError):
+        check_numeric_gradient(sym, {"data": _any(S)}, rtol=5e-2,
+                               atol=1e-3)

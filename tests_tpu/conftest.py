@@ -1,0 +1,22 @@
+"""TPU test lane: run with `python -m pytest tests_tpu/ -q` on a machine
+with a real TPU. Unlike tests/conftest.py this does NOT force the cpu
+platform — the default backend (the TPU) stays available, and the tests
+cross-check it against CPU-jax via check_consistency (the reference's
+tests/python/gpu/test_operator_gpu.py pattern)."""
+import pytest
+
+import jax
+
+
+def _has_tpu():
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _has_tpu():
+        skip = pytest.mark.skip(reason="no TPU backend available")
+        for item in items:
+            item.add_marker(skip)

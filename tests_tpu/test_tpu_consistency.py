@@ -1,0 +1,82 @@
+"""CPU-jax vs TPU-jax backend parity (role of
+tests/python/gpu/test_operator_gpu.py + check_consistency,
+python/mxnet/test_utils.py:1207). Tolerances account for the TPU MXU's
+bf16 matmul passes (XLA DEFAULT precision)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency, assert_almost_equal
+
+
+def _pair(shapes):
+    return [dict(ctx=mx.cpu(0), **shapes), dict(ctx=mx.tpu(0), **shapes)]
+
+
+ELEMWISE_RTOL = 1e-4
+MXU_RTOL = 5e-3   # matmul/conv run as bf16 MXU passes
+MXU_ATOL = 5e-2
+
+
+def test_elementwise_consistency():
+    d = mx.sym.Variable("data")
+    sym = mx.sym.tanh(mx.sym.exp(d * 0.3) + mx.sym.sigmoid(d))
+    check_consistency(sym, _pair({"data": (4, 5)}), rtol=ELEMWISE_RTOL,
+                      atol=1e-4)
+
+
+def test_fc_consistency():
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc")
+    check_consistency(sym, _pair({"data": (4, 6)}), rtol=MXU_RTOL,
+                      atol=MXU_ATOL)
+
+
+def test_conv_bn_pool_consistency():
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                           name="conv")
+    b = mx.sym.BatchNorm(c, name="bn", fix_gamma=False)
+    p = mx.sym.Pooling(b, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    check_consistency(p, _pair({"data": (2, 3, 8, 8)}), rtol=MXU_RTOL,
+                      atol=MXU_ATOL)
+
+
+def test_softmax_reduce_consistency():
+    d = mx.sym.Variable("data")
+    sym = mx.sym.sum(mx.sym.log_softmax(d, axis=1), axis=0)
+    check_consistency(sym, _pair({"data": (4, 7)}), rtol=1e-4, atol=1e-4)
+
+
+def test_training_step_parity():
+    """3 SGD steps on TPU track CPU within bf16-matmul tolerance."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    Y = rng.randint(0, 3, size=64).astype(np.float32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    results = []
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        it = mx.io.NDArrayIter(X, Y, batch_size=32)
+        mod = mx.mod.Module(sym, context=ctx)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Constant(0.05))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        results.append(mod.get_params()[0]["fc_weight"].asnumpy())
+    assert_almost_equal(results[1], results[0], rtol=5e-3, atol=5e-3,
+                        names=("tpu", "cpu"))
+
+
+def test_rng_ops_run_on_tpu():
+    x = mx.nd.random.uniform(0, 1, shape=(64, 64), ctx=mx.tpu(0))
+    assert x.context.device_type in ("tpu", "gpu")
+    m = float(x.asnumpy().mean())
+    assert 0.4 < m < 0.6
