@@ -1,0 +1,31 @@
+"""mx.nd.contrib namespace.
+
+The reference synthesizes `mx.nd.contrib.*` from registry entries whose name
+starts with `_contrib_` (python/mxnet/ndarray/register.py via
+`_init_op_module('mxnet', 'ndarray', ...)` base.py:532). Same contract here:
+`mx.nd.contrib.foo` resolves the registered op `_contrib_foo`.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+
+_PREFIX = "_contrib_"
+
+
+def _resolve(name):
+    from . import __getattr__ as _nd_getattr  # late: avoid import cycle
+    full = _PREFIX + name
+    if full in _registry._REGISTRY:
+        return _nd_getattr(full)
+    if name in _registry._REGISTRY:   # e.g. ctc_loss alias
+        return _nd_getattr(name)
+    raise AttributeError(f"module 'mxnet_tpu.ndarray.contrib' has no "
+                         f"attribute {name!r}")
+
+
+def __getattr__(name):
+    fn = _resolve(name)
+    setattr(_sys.modules[__name__], name, fn)
+    return fn

@@ -5,5 +5,6 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import contrib  # noqa: F401
 
 from .registry import get_op, list_ops  # noqa: F401

@@ -31,12 +31,26 @@ __all__ = ["Param", "OpSchema", "OpCtx", "register", "get_op", "list_ops",
            "AttrDict"]
 
 
+def _parse_floats(v):
+    """Tuple-of-float attr ((1.0, 2.0), "[1,2]", 0.5 -> tuple of float) —
+    role of nnvm::Tuple<float> params (sizes/ratios/variances)."""
+    if isinstance(v, (int, float, _np.floating, _np.integer)):
+        return (float(v),)
+    if isinstance(v, str):
+        import ast
+        v = ast.literal_eval(v.strip())
+        if not isinstance(v, (tuple, list)):
+            return (float(v),)
+    return tuple(float(x) for x in v)
+
+
 _PARSERS = {
     "int": parse_int,
     "float": parse_float,
     "bool": parse_bool,
     "str": lambda v: str(v),
     "shape": parse_shape,
+    "floats": _parse_floats,
     "dtype": lambda v: v if isinstance(v, str) else _np.dtype(v).name,
     "any": lambda v: v,
 }

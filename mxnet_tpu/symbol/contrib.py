@@ -1,0 +1,26 @@
+"""mx.sym.contrib namespace — symbolic twins of mx.nd.contrib.
+
+Mirrors the reference's `_init_op_module('mxnet', 'symbol', ...)` contrib
+sub-namespace (python/mxnet/symbol/register.py:202).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+
+_PREFIX = "_contrib_"
+
+
+def __getattr__(name):
+    from . import __getattr__ as _sym_getattr  # late: avoid import cycle
+    full = _PREFIX + name
+    if full in _registry._REGISTRY:
+        fn = _sym_getattr(full)
+    elif name in _registry._REGISTRY:
+        fn = _sym_getattr(name)
+    else:
+        raise AttributeError(f"module 'mxnet_tpu.symbol.contrib' has no "
+                             f"attribute {name!r}")
+    setattr(_sys.modules[__name__], name, fn)
+    return fn

@@ -1,0 +1,539 @@
+"""Contrib / detection operator tests.
+
+Reference patterns: tests/python/unittest/test_operator.py (test_multibox_*,
+test_box_nms via test_contrib_operator.py ideas), with naive numpy oracles
+computed here rather than ported.
+"""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def nd(x, dtype=np.float32):
+    return mx.nd.array(np.asarray(x, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+def test_multibox_prior_basic():
+    data = nd(np.zeros((1, 3, 2, 3)))
+    out = mx.nd.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    assert out.shape == (1, 2 * 3 * 1, 4)
+    a = out.asnumpy()[0]
+    # first anchor: center ((0+.5)/3, (0+.5)/2), half extents (.5*2/3/2, .25)
+    cx, cy = 0.5 / 3, 0.5 / 2
+    hw, hh = 0.5 * 2 / 3 / 2, 0.25
+    np.testing.assert_allclose(a[0], [cx - hw, cy - hh, cx + hw, cy + hh],
+                               rtol=1e-5)
+    # anchors laid out row-major over (y, x)
+    cx2 = 1.5 / 3
+    np.testing.assert_allclose(a[1][0], cx2 - hw, rtol=1e-5)
+
+
+def test_multibox_prior_counts_and_clip():
+    data = nd(np.zeros((1, 8, 4, 4)))
+    out = mx.nd.contrib.MultiBoxPrior(data, sizes=(0.9, 0.4),
+                                      ratios=(1, 2, 0.5), clip=True)
+    assert out.shape == (1, 4 * 4 * 4, 4)
+    a = out.asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# box_nms / box_iou / bipartite_matching
+# ---------------------------------------------------------------------------
+
+def naive_iou(a, b):
+    w = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    h = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    i = w * h
+    u = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - i
+    return 0.0 if u <= 0 else i / u
+
+
+def naive_nms(rows, thresh, topk, force, cs=2, si=1, ii=0):
+    order = np.argsort(-rows[:, si], kind="stable")
+    k = len(order) if topk < 0 else min(topk, len(order))
+    cand = list(order[:k])
+    keep = []
+    while cand:
+        i = cand.pop(0)
+        keep.append(i)
+        cand = [j for j in cand
+                if not ((force or rows[i, ii] == rows[j, ii]) and
+                        naive_iou(rows[i, cs:cs + 4], rows[j, cs:cs + 4])
+                        > thresh)]
+    out = np.full_like(rows, -1.0)
+    for slot, i in enumerate(keep):
+        out[slot] = rows[i]
+    return out
+
+
+@pytest.mark.parametrize("force,topk", [(False, -1), (True, -1), (False, 3)])
+def test_box_nms_matches_naive(force, topk):
+    rng = np.random.RandomState(7)
+    n = 12
+    xy = rng.uniform(0, 0.7, size=(n, 2))
+    wh = rng.uniform(0.1, 0.3, size=(n, 2))
+    rows = np.concatenate([rng.randint(0, 2, size=(n, 1)).astype(np.float32),
+                           rng.uniform(0.1, 1.0, size=(n, 1)),
+                           xy, xy + wh], axis=1).astype(np.float32)
+    got = mx.nd.contrib.box_nms(nd(rows), overlap_thresh=0.45, topk=topk,
+                                coord_start=2, score_index=1, id_index=0,
+                                force_suppress=force).asnumpy()
+    want = naive_nms(rows, 0.45, topk, force)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_format_conversion():
+    # one surviving box: center (0.5,0.5) w=h=0.2 -> corner (.4,.4,.6,.6);
+    # negative-center box must also convert
+    rows = np.array([[0, 0.9, 0.5, 0.5, 0.2, 0.2],
+                     [1, 0.8, -0.2, 0.3, 0.2, 0.2]], np.float32)
+    out = mx.nd.contrib.box_nms(nd(rows), overlap_thresh=0.5,
+                                coord_start=2, score_index=1, id_index=0,
+                                in_format="center",
+                                out_format="corner").asnumpy()
+    np.testing.assert_allclose(out[0, 2:], [0.4, 0.4, 0.6, 0.6], atol=1e-6)
+    np.testing.assert_allclose(out[1, 2:], [-0.3, 0.2, -0.1, 0.4], atol=1e-6)
+
+
+def test_box_nms_batch_shape():
+    rng = np.random.RandomState(3)
+    data = rng.uniform(0, 1, size=(2, 3, 6, 5)).astype(np.float32)
+    out = mx.nd.contrib.box_nms(nd(data), overlap_thresh=0.5,
+                                coord_start=1, score_index=0)
+    assert out.shape == data.shape
+
+
+def test_box_iou():
+    a = nd([[0, 0, 1, 1], [0.5, 0.5, 1.5, 1.5]])
+    b = nd([[0, 0, 1, 1]])
+    out = mx.nd.contrib.box_iou(a, b).asnumpy()
+    assert out.shape == (2, 1)
+    np.testing.assert_allclose(out[:, 0], [1.0, 0.25 / 1.75], rtol=1e-5)
+
+
+def test_bipartite_matching():
+    score = nd([[[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]]])
+    rm, cm = mx.nd.contrib.bipartite_matching(score, threshold=1e-12)
+    np.testing.assert_array_equal(rm.asnumpy()[0], [1, -1, 0])
+    np.testing.assert_array_equal(cm.asnumpy()[0], [2, 0])
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget / MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+def test_multibox_target_simple():
+    # 3 anchors, one matching gt well, one background
+    anchors = nd([[[0.1, 0.1, 0.5, 0.5],
+                   [0.6, 0.6, 0.9, 0.9],
+                   [0.0, 0.0, 0.1, 0.1]]])
+    # one gt box of class 2 overlapping anchor 0
+    label = nd([[[2, 0.1, 0.1, 0.45, 0.5],
+                 [-1, -1, -1, -1, -1]]])
+    cls_pred = nd(np.zeros((1, 4, 3)))
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    cls = cls_t.asnumpy()[0]
+    assert cls[0] == 3.0          # class id + 1
+    assert cls[1] == 0.0 and cls[2] == 0.0   # background
+    m = loc_m.asnumpy()[0]
+    assert m[:4].sum() == 4 and m[4:].sum() == 0
+    # encoded loc target for anchor 0
+    t = loc_t.asnumpy()[0][:4]
+    aw, ah, ax, ay = 0.4, 0.4, 0.3, 0.3
+    gw, gh, gx, gy = 0.35, 0.4, 0.275, 0.3
+    want = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+            math.log(gw / aw) / 0.2, math.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(t, want, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_no_gt():
+    anchors = nd(np.random.RandomState(0).uniform(0, 1, (1, 5, 4)))
+    label = nd(-np.ones((2, 3, 5)))
+    cls_pred = nd(np.zeros((2, 4, 5)))
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(anchors, label,
+                                                       cls_pred)
+    assert (cls_t.asnumpy() == -1).all()
+    assert (loc_m.asnumpy() == 0).all()
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd([[[0.1, 0.1, 0.5, 0.5],
+                   [0.6, 0.6, 0.9, 0.9],
+                   [0.0, 0.0, 0.1, 0.1],
+                   [0.5, 0.0, 0.9, 0.4]]])
+    label = nd([[[0, 0.1, 0.1, 0.5, 0.5]]])
+    # background logits low for anchor 1 -> it is the hardest negative
+    cp = np.zeros((1, 3, 4), np.float32)
+    cp[0, 0] = [5.0, -2.0, 5.0, 5.0]
+    cls_t = mx.nd.contrib.MultiBoxTarget(
+        anchors, label, nd(cp), negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5)[2].asnumpy()[0]
+    assert cls_t[0] == 1.0            # positive
+    assert cls_t[1] == 0.0            # mined negative (hardest)
+    assert cls_t[2] == -1.0 and cls_t[3] == -1.0   # ignored
+
+
+def test_multibox_detection_roundtrip():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.5, 0.5, 0.9, 0.9]]], np.float32)
+    gt = np.array([0.15, 0.12, 0.45, 0.48], np.float32)
+    # encode gt against anchor 0 with the Target op, decode with Detection
+    label = nd([[np.concatenate([[1], gt])]])
+    cls_pred = nd(np.zeros((1, 3, 2)))
+    loc_t = mx.nd.contrib.MultiBoxTarget(nd(anchors), label, cls_pred)[0]
+    cls_prob = nd([[[0.1, 0.9], [0.1, 0.05], [0.8, 0.05]]])  # (1,3,2)
+    out = mx.nd.contrib.MultiBoxDetection(
+        cls_prob, loc_t, nd(anchors), threshold=0.2, clip=False).asnumpy()[0]
+    # one detection: class 1 (0-based fg id 1), score 0.8, box ~= gt
+    assert out[0][0] == 1.0
+    np.testing.assert_allclose(out[0][1], 0.8, rtol=1e-5)
+    np.testing.assert_allclose(out[0][2:], gt, rtol=1e-3, atol=1e-4)
+    assert (out[1:, 0] == -1).all()
+
+
+def test_multibox_detection_nms():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.11, 0.1, 0.51, 0.5],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]     # same class, overlapping first two
+    out = mx.nd.contrib.MultiBoxDetection(
+        nd(cls_prob), nd(np.zeros((1, 12))), nd(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    ids = out[:, 0]
+    assert ids[0] == 0.0 and ids[1] == -1.0   # overlapping 0.8-row suppressed
+    assert ids[2] == 0.0                      # non-overlapping box survives
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling
+# ---------------------------------------------------------------------------
+
+def naive_roi_pool(data, rois, psize, scale):
+    n, c, h, w = data.shape
+    ph, pw = psize
+    out = np.zeros((len(rois), c, ph, pw), data.dtype)
+    for ri, roi in enumerate(rois):
+        b = int(roi[0])
+        # C round(): half away from zero
+        x1, y1, x2, y2 = [int(math.copysign(math.floor(abs(v * scale) + 0.5),
+                                            v * scale)) for v in roi[1:]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            hs = min(max(y1 + int(np.floor(i * rh / ph)), 0), h)
+            he = min(max(y1 + int(np.ceil((i + 1) * rh / ph)), 0), h)
+            for j in range(pw):
+                ws = min(max(x1 + int(np.floor(j * rw / pw)), 0), w)
+                we = min(max(x1 + int(np.ceil((j + 1) * rw / pw)), 0), w)
+                if he > hs and we > ws:
+                    out[ri, :, i, j] = data[b, :, hs:he, ws:we].max((1, 2))
+    return out
+
+
+def test_roi_pooling_matches_naive():
+    rng = np.random.RandomState(11)
+    data = rng.normal(size=(2, 3, 12, 16)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 15, 11],
+                     [1, 4, 4, 11, 11],
+                     [0, 6, 2, 14, 10]], np.float32)
+    got = mx.nd.ROIPooling(nd(data), nd(rois), pooled_size=(4, 4),
+                           spatial_scale=1.0).asnumpy()
+    want = naive_roi_pool(data, rois, (4, 4), 1.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_roi_pooling_scale():
+    data = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 15, 15]], np.float32)
+    out = mx.nd.ROIPooling(nd(data), nd(rois), pooled_size=(2, 2),
+                           spatial_scale=0.5).asnumpy()
+    want = naive_roi_pool(data, rois, (2, 2), 0.5)
+    np.testing.assert_allclose(out, want)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler / GridGenerator / SpatialTransformer
+# ---------------------------------------------------------------------------
+
+def identity_grid(h, w):
+    ys = np.linspace(-1, 1, h, dtype=np.float32)
+    xs = np.linspace(-1, 1, w, dtype=np.float32)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    return np.stack([gx, gy])[None]
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=(1, 2, 5, 7)).astype(np.float32)
+    out = mx.nd.BilinearSampler(nd(data), nd(identity_grid(5, 7))).asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_sampler_shift_and_oob():
+    data = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    g = identity_grid(3, 3)
+    g[0, 0] += 2.0 / 2  # shift x by one pixel
+    out = mx.nd.BilinearSampler(nd(data), nd(g)).asnumpy()[0, 0]
+    np.testing.assert_allclose(out[:, :2], data[0, 0][:, 1:], atol=1e-6)
+    np.testing.assert_allclose(out[:, 2], 0.0, atol=1e-6)  # zero pad
+
+
+def test_grid_generator_affine_identity():
+    theta = nd([[1, 0, 0, 0, 1, 0]])
+    out = mx.nd.GridGenerator(theta, transform_type="affine",
+                              target_shape=(4, 6)).asnumpy()
+    np.testing.assert_allclose(out, identity_grid(4, 6), atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = nd(np.zeros((2, 2, 3, 5)))
+    out = mx.nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    np.testing.assert_allclose(out[0], identity_grid(3, 5)[0], atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(5)
+    data = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    theta = nd(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(np.float32))
+    out = mx.nd.SpatialTransformer(nd(data), theta, target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveAvgPooling2D / BilinearResize2D
+# ---------------------------------------------------------------------------
+
+def test_adaptive_avg_pool():
+    rng = np.random.RandomState(2)
+    data = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(nd(data),
+                                             output_size=(2, 2)).asnumpy()
+    want = data.reshape(2, 3, 2, 4, 2, 4).mean((3, 5))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    # global
+    out1 = mx.nd.contrib.AdaptiveAvgPooling2D(nd(data)).asnumpy()
+    np.testing.assert_allclose(out1[..., 0, 0], data.mean((2, 3)), rtol=1e-5)
+
+
+def test_adaptive_avg_pool_uneven():
+    data = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(nd(data),
+                                             output_size=(1, 3)).asnumpy()
+    # bins [0,2),[1,4),[3,5) per floor/ceil rule
+    np.testing.assert_allclose(out[0, 0, 0], [0.5, 2.0, 3.5], rtol=1e-6)
+
+
+def test_bilinear_resize():
+    rng = np.random.RandomState(4)
+    data = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+    same = mx.nd.contrib.BilinearResize2D(nd(data), height=4,
+                                          width=4).asnumpy()
+    np.testing.assert_allclose(same, data, rtol=1e-5, atol=1e-6)
+    up = mx.nd.contrib.BilinearResize2D(nd(data), height=7, width=7).asnumpy()
+    assert up.shape == (1, 2, 7, 7)
+    # corners preserved under align_corners semantics
+    np.testing.assert_allclose(up[..., 0, 0], data[..., 0, 0], atol=1e-6)
+    np.testing.assert_allclose(up[..., -1, -1], data[..., -1, -1], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+def naive_correlation(d1, d2, k, md, s1, s2, pad, mul):
+    n, c, h, w = d1.shape
+    kr = (k - 1) // 2
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = int(np.ceil((ph - 2 * border) / s1))
+    ow = int(np.ceil((pw - 2 * border) / s1))
+    r = md // s2
+    d = 2 * r + 1
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, d * d, oh, ow), np.float32)
+    for b in range(n):
+        for qi, (dy, dx) in enumerate(itertools.product(range(-r, r + 1),
+                                                        repeat=2)):
+            for y in range(oh):
+                for x in range(ow):
+                    y1, x1 = y * s1 + md, x * s1 + md
+                    y2, x2 = y1 + dy * s2, x1 + dx * s2
+                    a = p1[b, :, y1:y1 + k, x1:x1 + k]
+                    bb = p2[b, :, y2:y2 + k, x2:x2 + k]
+                    v = (a * bb) if mul else np.abs(a - bb)
+                    out[b, qi, y, x] = v.sum() / (k * k * c)
+    return out
+
+
+@pytest.mark.parametrize("mul", [True, False])
+def test_correlation_matches_naive(mul):
+    rng = np.random.RandomState(9)
+    d1 = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    d2 = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    got = mx.nd.Correlation(nd(d1), nd(d2), kernel_size=3,
+                            max_displacement=2, stride1=1, stride2=1,
+                            pad_size=2, is_multiply=mul).asnumpy()
+    want = naive_correlation(d1, d2, 3, 2, 1, 1, 2, mul)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# CTCLoss
+# ---------------------------------------------------------------------------
+
+def brute_force_ctc(probs, label):
+    """Sum probability over all alignments (T small). probs (T,A) softmaxed,
+    blank = 0."""
+    t_len, a = probs.shape
+
+    def collapse(path):
+        out = []
+        prev = None
+        for p in path:
+            if p != prev and p != 0:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(a), repeat=t_len):
+        if collapse(path) == tuple(label):
+            p = 1.0
+            for t, s in enumerate(path):
+                p *= probs[t, s]
+            total += p
+    return -math.log(total)
+
+
+def test_ctc_loss_brute_force():
+    rng = np.random.RandomState(6)
+    t_len, b, a = 4, 2, 3
+    acts = rng.normal(size=(t_len, b, a)).astype(np.float32)
+    probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
+    labels = np.array([[1, 2], [2, 0]], np.float32)   # second padded
+    loss = mx.nd.contrib.ctc_loss(nd(acts), nd(labels)).asnumpy()
+    want0 = brute_force_ctc(probs[:, 0], [1, 2])
+    want1 = brute_force_ctc(probs[:, 1], [2])
+    np.testing.assert_allclose(loss, [want0, want1], rtol=1e-4)
+
+
+def test_ctc_loss_lengths_and_blank_last():
+    rng = np.random.RandomState(8)
+    t_len, b, a = 5, 1, 4
+    acts = rng.normal(size=(t_len, b, a)).astype(np.float32)
+    probs = np.exp(acts) / np.exp(acts).sum(-1, keepdims=True)
+    # blank = last (index 3); labels 0-based real classes
+    labels = np.array([[0, 1, -1]], np.float32)
+    loss = mx.nd.contrib.ctc_loss(nd(acts), nd(labels),
+                                  blank_label="last").asnumpy()
+
+    def collapse(path):
+        out, prev = [], None
+        for p in path:
+            if p != prev and p != 3:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(a), repeat=t_len):
+        if collapse(path) == (0, 1):
+            p = 1.0
+            for t, s in enumerate(path):
+                p *= probs[t, 0, s]
+            total += p
+    np.testing.assert_allclose(loss[0], -math.log(total), rtol=1e-4)
+    # data_lengths: truncate to first 3 frames
+    dl = mx.nd.contrib.ctc_loss(nd(acts), nd([[1.0, 2.0]]),
+                                nd([3.0]), use_data_lengths=True).asnumpy()
+    want = brute_force_ctc(probs[:3, 0], [1, 2])
+    np.testing.assert_allclose(dl[0], want, rtol=1e-4)
+
+
+def test_ctc_loss_grad_finite_diff():
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(12)
+    acts = rng.normal(size=(3, 1, 3)).astype(np.float64)
+    labels = np.array([[1.0]], np.float64)
+    x = nd(acts, dtype=np.float64)
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.contrib.ctc_loss(x, nd(labels, dtype=np.float64))
+    loss.backward()
+    g = x.grad.asnumpy()
+    eps = 1e-2   # fp32 end to end: central difference needs a coarse step
+    for idx in [(0, 0, 0), (1, 0, 1), (2, 0, 2)]:
+        ap = acts.copy()
+        ap[idx] += eps
+        am = acts.copy()
+        am[idx] -= eps
+        lp = mx.nd.contrib.ctc_loss(nd(ap, np.float64),
+                                    nd(labels, np.float64)).asnumpy()[0]
+        lm = mx.nd.contrib.ctc_loss(nd(am, np.float64),
+                                    nd(labels, np.float64)).asnumpy()[0]
+        np.testing.assert_allclose(g[idx], (lp - lm) / (2 * eps),
+                                   rtol=5e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft / count_sketch / khatri_rao / quadratic
+# ---------------------------------------------------------------------------
+
+def test_fft_ifft():
+    rng = np.random.RandomState(1)
+    data = rng.normal(size=(3, 8)).astype(np.float32)
+    out = mx.nd.contrib.fft(nd(data)).asnumpy()
+    spec = np.fft.fft(data, axis=-1)
+    want = np.stack([spec.real, spec.imag], -1).reshape(3, 16)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    back = mx.nd.contrib.ifft(nd(out)).asnumpy()
+    np.testing.assert_allclose(back, data * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    data = nd([[1.0, 2.0, 3.0, 4.0]])
+    h = nd([[0, 1, 0, 2]])
+    s = nd([[1, -1, 1, 1]])
+    out = mx.nd.contrib.count_sketch(data, h, s, out_dim=3).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0, 4.0]])
+
+
+def test_khatri_rao():
+    a = nd([[1.0, -1.0], [2.0, -3.0]])
+    b = nd([[1.0, 4.0], [2.0, 5.0]])
+    out = mx.nd.khatri_rao(a, b).asnumpy()
+    want = np.stack([np.kron(a.asnumpy()[i], b.asnumpy()[i])
+                     for i in range(2)])
+    np.testing.assert_allclose(out, want)
+
+
+def test_quadratic():
+    x = nd([[1.0, 2.0], [3.0, 4.0]])
+    out = mx.nd.contrib.quadratic(x, a=2.0, b=3.0, c=1.0).asnumpy()
+    np.testing.assert_allclose(out, 2 * x.asnumpy() ** 2 + 3 * x.asnumpy() + 1)
+
+
+def test_contrib_symbolic_compose():
+    """Contrib ops compose into Symbol graphs and bind (SSD head slice)."""
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              name="conv")
+    anchors = mx.sym.contrib.MultiBoxPrior(conv, sizes=(0.5, 0.3),
+                                           ratios=(1, 2))
+    ex = anchors.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    out = ex.forward()[0]
+    assert out.shape == (1, 8 * 8 * 3, 4)
