@@ -73,6 +73,12 @@ def _to_corner(boxes):
     return jnp.stack([x - w, y - h, x + w, y + h], axis=-1)
 
 
+def _round_half_away(v):
+    """C round(): half away from zero — NOT numpy/jax banker's rounding
+    (roi_pooling.cc:69, psroi_pooling.cu:72)."""
+    return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+
 def _pair_iou(a, b, fmt="corner"):
     """IoU of every a-box against every b-box: a (…,A,4), b (…,B,4) ->
     (…,A,B). Matches CalculateOverlap (multibox_detection.cc:75): u<=0 -> 0."""
@@ -362,6 +368,7 @@ register("_contrib_MultiBoxDetection", _multibox_detection,
 
 def _box_nms(attrs, octx, data):
     thresh = attrs["overlap_thresh"]
+    valid_thresh = attrs["valid_thresh"]
     topk = attrs["topk"]
     cs, si, ii = attrs["coord_start"], attrs["score_index"], attrs["id_index"]
     force = attrs["force_suppress"]
@@ -375,13 +382,17 @@ def _box_nms(attrs, octx, data):
 
     def one(rows):
         scores = rows[:, si]
-        order = jnp.argsort(-scores, stable=True)
+        valid = scores > valid_thresh
+        # invalid rows sort to the back and never enter the candidate set
+        order = jnp.argsort(jnp.where(valid, -scores, _NEG * -1),
+                            stable=True)
         srows = rows[order]
         boxes = srows[:, cs:cs + 4]
         if in_fmt == "center":
             boxes = _to_corner(boxes)
         ids = srows[:, ii] if ii >= 0 else jnp.zeros(n, rows.dtype)
         keep = _nms_keep_strict(boxes, ids, k, thresh, force)
+        keep = keep & valid[order]
         # pack survivors to the front (score order preserved), -1 elsewhere
         pack = jnp.argsort(~keep, stable=True)
         out = srows[pack]
@@ -422,6 +433,7 @@ def _nms_keep_strict(boxes, ids, k, thresh, force):
 
 register("_contrib_box_nms", _box_nms,
          params={"overlap_thresh": Param("float", 0.5),
+                 "valid_thresh": Param("float", 0.0),
                  "topk": Param("int", -1),
                  "coord_start": Param("int", 2),
                  "score_index": Param("int", 1),
@@ -518,17 +530,12 @@ def _roi_pooling(attrs, octx, data, rois):
     scale = attrs["spatial_scale"]
     n, c, h, w = data.shape
 
-    def rnd(v):
-        # C round(): half away from zero (roi_pooling.cc:69) — NOT
-        # numpy/jax banker's rounding
-        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
-
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = rnd(roi[1] * scale)
-        y1 = rnd(roi[2] * scale)
-        x2 = rnd(roi[3] * scale)
-        y2 = rnd(roi[4] * scale)
+        x1 = _round_half_away(roi[1] * scale)
+        y1 = _round_half_away(roi[2] * scale)
+        x2 = _round_half_away(roi[3] * scale)
+        y2 = _round_half_away(roi[4] * scale)
         rh = jnp.maximum(y2 - y1 + 1, 1.0)
         rw = jnp.maximum(x2 - x1 + 1, 1.0)
         img = data[jnp.clip(bidx, 0, n - 1)]               # (C,H,W)
@@ -876,8 +883,18 @@ def _ctc_one(logp, lab, dlen, llen, blank):
     return -jnp.logaddexp(end1, end2)
 
 
-def _ctc_loss(attrs, octx, data, label, data_lengths=None,
-              label_lengths=None):
+def _ctc_loss(attrs, octx, data, label, *rest):
+    # optional length inputs arrive positionally — dispatch on the flags,
+    # not on argument position (use_label_lengths alone must NOT bind the
+    # lengths array to data_lengths)
+    data_lengths = label_lengths = None
+    i = 0
+    if attrs["use_data_lengths"]:
+        data_lengths = rest[i]
+        i += 1
+    if attrs["use_label_lengths"]:
+        label_lengths = rest[i]
+        i += 1
     t_max, b, a = data.shape
     blank_first = attrs["blank_label"] == "first"
     blank = 0 if blank_first else a - 1
@@ -996,19 +1013,21 @@ register("_contrib_count_sketch", _count_sketch,
 
 
 def _khatri_rao(attrs, octx, *mats):
+    # column-wise Khatri-Rao: all matrices share the column count; rows
+    # Kronecker-multiply (krprod.cc khatri_rao)
     out = mats[0]
     for m in mats[1:]:
-        out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
     return _t(out)
 
 
 def _khatri_rao_infer(attrs, in_shapes):
     if any(s is None for s in in_shapes):
         return in_shapes, [None]
-    cols = 1
+    rows = 1
     for s in in_shapes:
-        cols *= s[1]
-    return in_shapes, [(in_shapes[0][0], cols)]
+        rows *= s[0]
+    return in_shapes, [(rows, in_shapes[0][1])]
 
 
 register("khatri_rao", _khatri_rao,
@@ -1025,3 +1044,487 @@ register("_contrib_quadratic", _quadratic,
          params={"a": Param("float", 0.0), "b": Param("float", 0.0),
                  "c": Param("float", 0.0)},
          inputs=("data",))
+
+
+# ---------------------------------------------------------------------------
+# R-CNN family: Proposal / MultiProposal (src/operator/contrib/proposal.cc,
+# multi_proposal.cc), PSROIPooling (psroi_pooling.cu), DeformableConvolution
+# (deformable_convolution.cc), DeformablePSROIPooling
+# (deformable_psroi_pooling.cu)
+# ---------------------------------------------------------------------------
+
+def _rpn_base_anchors(feature_stride, ratios, scales):
+    """py-faster-rcnn anchor table (proposal-inl.h GenerateAnchors :214,
+    _Transform :196): ratios outer, scales inner; +1-width conventions."""
+    fs = float(feature_stride)
+    w = h = fs
+    x_ctr = y_ctr = (fs - 1.0) / 2.0
+    size = w * h
+    rows = []
+    for ratio in ratios:
+        size_ratio = math.floor(size / ratio)
+        new_w = math.floor(math.sqrt(size_ratio) + 0.5)
+        new_h = math.floor(new_w * ratio + 0.5)
+        for scale in scales:
+            sw, sh = new_w * scale, new_h * scale
+            rows.append([x_ctr - 0.5 * (sw - 1), y_ctr - 0.5 * (sh - 1),
+                         x_ctr + 0.5 * (sw - 1), y_ctr + 0.5 * (sh - 1)])
+    return _np.asarray(rows, _np.float32)
+
+
+def _proposal_one(fg_scores, deltas, iminfo, attrs):
+    """RPN proposal generation for a single image.
+    fg_scores (A,H,W), deltas (4A,H,W), iminfo (3,)."""
+    a, h, w = fg_scores.shape
+    fs = attrs["feature_stride"]
+    if a != len(attrs["ratios"]) * len(attrs["scales"]):
+        # proposal.cc:341 CHECK_EQ(num_anchors, ratios * scales)
+        raise MXNetError(
+            f"Proposal: cls_prob has {a} anchors per position but "
+            f"ratios x scales = "
+            f"{len(attrs['ratios']) * len(attrs['scales'])}")
+    base = jnp.asarray(_rpn_base_anchors(fs, attrs["ratios"],
+                                         attrs["scales"]))
+    sx = jnp.arange(w, dtype=fg_scores.dtype) * fs
+    sy = jnp.arange(h, dtype=fg_scores.dtype) * fs
+    shift = jnp.stack(
+        [jnp.broadcast_to(sx[None, :], (h, w)),
+         jnp.broadcast_to(sy[:, None], (h, w)),
+         jnp.broadcast_to(sx[None, :], (h, w)),
+         jnp.broadcast_to(sy[:, None], (h, w))], axis=-1)    # (H,W,4)
+    anchors = shift[:, :, None, :] + base[None, None]        # (H,W,A,4)
+
+    d = deltas.reshape(a, 4, h, w).transpose(2, 3, 0, 1)     # (H,W,A,4)
+    im_h, im_w, im_scale = iminfo[0], iminfo[1], iminfo[2]
+    if attrs["iou_loss"]:
+        pred = anchors + d
+    else:
+        # +1-width box decode (proposal.cc BBoxTransformInv :37-90)
+        aw = anchors[..., 2] - anchors[..., 0] + 1.0
+        ah = anchors[..., 3] - anchors[..., 1] + 1.0
+        ax = anchors[..., 0] + 0.5 * (aw - 1.0)
+        ay = anchors[..., 1] + 0.5 * (ah - 1.0)
+        cx = d[..., 0] * aw + ax
+        cy = d[..., 1] * ah + ay
+        pw = jnp.exp(d[..., 2]) * aw
+        phh = jnp.exp(d[..., 3]) * ah
+        pred = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (phh - 1),
+                          cx + 0.5 * (pw - 1), cy + 0.5 * (phh - 1)],
+                         axis=-1)
+    lo = jnp.zeros(4, pred.dtype)
+    hi = jnp.stack([im_w - 1, im_h - 1, im_w - 1, im_h - 1])
+    pred = jnp.clip(pred, lo, hi)
+
+    score = jnp.transpose(fg_scores, (1, 2, 0))              # (H,W,A)
+    # drop anchors in the padded region beyond the true image extent
+    real_h = jnp.floor(im_h / fs)
+    real_w = jnp.floor(im_w / fs)
+    inside = (jnp.arange(h)[:, None, None] < real_h) & \
+             (jnp.arange(w)[None, :, None] < real_w)
+    score = jnp.where(inside, score, -1.0)
+    # drop boxes smaller than rpn_min_size (scaled to input image)
+    min_size = attrs["rpn_min_size"] * im_scale
+    iw = pred[..., 2] - pred[..., 0] + 1.0
+    ih = pred[..., 3] - pred[..., 1] + 1.0
+    score = jnp.where((iw < min_size) | (ih < min_size), -1.0, score)
+
+    boxes = pred.reshape(-1, 4)
+    score = score.reshape(-1)
+    count = boxes.shape[0]
+    pre_n = attrs["rpn_pre_nms_top_n"]
+    pre_n = count if pre_n <= 0 else min(pre_n, count)
+    post_n = min(attrs["rpn_post_nms_top_n"], pre_n)
+
+    top_scores, top_idx = jax.lax.top_k(score, pre_n)
+    top_boxes = boxes[top_idx]
+    # +1-width pixel IoU (proposal.cc NonMaximumSuppression computes areas
+    # as (x2-x1+1)*(y2-y1+1)): shift the far corners by one before the
+    # standard corner IoU
+    nms_boxes = top_boxes + jnp.asarray([0.0, 0.0, 1.0, 1.0],
+                                        top_boxes.dtype)
+    keep = _nms_keep(nms_boxes, jnp.zeros(pre_n), jnp.full(pre_n, True),
+                     pre_n, attrs["threshold"], True)
+    pack = jnp.argsort(~keep, stable=True)
+    nkept = jnp.maximum(jnp.sum(keep), 1)
+    # pad to post_n by cycling kept proposals (proposal.cc :405-420)
+    slots = jnp.mod(jnp.arange(post_n), nkept)
+    sel = pack[slots]
+    return top_boxes[sel], top_scores[sel]
+
+
+def _proposal(attrs, octx, cls_prob, bbox_pred, im_info):
+    if cls_prob.shape[0] != 1:
+        # reference CHECKs batch==1 (proposal.cc:292); use MultiProposal
+        raise MXNetError("Proposal supports batch size 1 only; use "
+                         "_contrib_MultiProposal for batched inputs")
+    a2 = cls_prob.shape[1]
+    rois, scores = _proposal_one(cls_prob[0, a2 // 2:], bbox_pred[0],
+                                 im_info[0], attrs)
+    post_n = rois.shape[0]
+    out = jnp.concatenate([jnp.zeros((post_n, 1), rois.dtype), rois], axis=1)
+    return _t(out, scores[:, None])
+
+
+_PROPOSAL_PARAMS = {
+    "rpn_pre_nms_top_n": Param("int", 6000),
+    "rpn_post_nms_top_n": Param("int", 300),
+    "threshold": Param("float", 0.7),
+    "rpn_min_size": Param("int", 16),
+    "scales": Param("floats", (4.0, 8.0, 16.0, 32.0)),
+    "ratios": Param("floats", (0.5, 1.0, 2.0)),
+    "feature_stride": Param("int", 16),
+    "output_score": Param("bool", False),
+    "iou_loss": Param("bool", False),
+}
+
+
+def _proposal_infer(attrs, in_shapes):
+    cs = in_shapes[0]
+    if cs is None:
+        return in_shapes, [None, None]
+    count = (cs[1] // 2) * cs[2] * cs[3]
+    pre = attrs["rpn_pre_nms_top_n"]
+    pre = count if pre <= 0 else min(pre, count)
+    post = min(attrs["rpn_post_nms_top_n"], pre)
+    n = cs[0]
+    return in_shapes, [(n * post, 5), (n * post, 1)]
+
+
+register("_contrib_Proposal", _proposal, params=dict(_PROPOSAL_PARAMS),
+         inputs=("cls_prob", "bbox_pred", "im_info"), num_outputs=2,
+         infer_shape=_proposal_infer)
+
+
+def _multi_proposal(attrs, octx, cls_prob, bbox_pred, im_info):
+    a2 = cls_prob.shape[1]
+    rois, scores = jax.vmap(
+        lambda c, b, i: _proposal_one(c[a2 // 2:], b, i, attrs))(
+        cls_prob, bbox_pred, im_info)
+    n, post_n = rois.shape[:2]
+    bidx = jnp.broadcast_to(
+        jnp.arange(n, dtype=rois.dtype)[:, None, None], (n, post_n, 1))
+    out = jnp.concatenate([bidx, rois], axis=2).reshape(n * post_n, 5)
+    return _t(out, scores.reshape(n * post_n, 1))
+
+
+register("_contrib_MultiProposal", _multi_proposal,
+         params=dict(_PROPOSAL_PARAMS),
+         inputs=("cls_prob", "bbox_pred", "im_info"), num_outputs=2,
+         infer_shape=_proposal_infer)
+
+
+def _psroi_channel_maps(pooled, group):
+    """gh/gw index per bin (psroi_pooling.cu:100-103)."""
+    g = _np.clip((_np.arange(pooled) * group) // pooled, 0, group - 1)
+    return jnp.asarray(g, jnp.int32)
+
+
+def _psroi_pooling(attrs, octx, data, rois):
+    scale = attrs["spatial_scale"]
+    od = attrs["output_dim"]
+    p = attrs["pooled_size"]
+    g = attrs["group_size"] or p
+    n, channels, h, w = data.shape
+    if channels != od * g * g:
+        raise MXNetError(f"PSROIPooling: data channels {channels} != "
+                         f"output_dim*group_size^2 = {od * g * g}")
+    ghi = gwi = _psroi_channel_maps(p, g)
+
+    def one_roi(roi):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, n - 1)
+        x1 = _round_half_away(roi[1]) * scale
+        y1 = _round_half_away(roi[2]) * scale
+        x2 = (_round_half_away(roi[3]) + 1.0) * scale
+        y2 = (_round_half_away(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        i = jnp.arange(p, dtype=data.dtype)
+        hs = jnp.clip(jnp.floor(i * bh + y1), 0, h)
+        he = jnp.clip(jnp.ceil((i + 1) * bh + y1), 0, h)
+        ws = jnp.clip(jnp.floor(i * bw + x1), 0, w)
+        we = jnp.clip(jnp.ceil((i + 1) * bw + x1), 0, w)
+        posh = jnp.arange(h, dtype=data.dtype)[None, :]
+        posw = jnp.arange(w, dtype=data.dtype)[None, :]
+        mh = ((posh >= hs[:, None]) & (posh < he[:, None])).astype(data.dtype)
+        mw = ((posw >= ws[:, None]) & (posw < we[:, None])).astype(data.dtype)
+        img = data[bidx].reshape(od, g, g, h, w)
+        sel = img[:, ghi][:, :, gwi]                        # (od,p,p,H,W)
+        tot = jnp.einsum("ocdhw,ch,dw->ocd", sel, mh, mw)
+        area = mh.sum(1)[:, None] * mw.sum(1)[None, :]
+        return jnp.where(area > 0, tot / jnp.maximum(area, 1.0), 0.0)
+
+    return _t(jax.vmap(one_roi)(rois))
+
+
+def _psroi_infer(attrs, in_shapes):
+    ds, rs = in_shapes
+    if ds is None or rs is None:
+        return in_shapes, [None]
+    p = attrs["pooled_size"]
+    return in_shapes, [(rs[0], attrs["output_dim"], p, p)]
+
+
+register("_contrib_PSROIPooling", _psroi_pooling,
+         params={"spatial_scale": Param("float", None, True),
+                 "output_dim": Param("int", None, True),
+                 "pooled_size": Param("int", None, True),
+                 "group_size": Param("int", 0)},
+         inputs=("data", "rois"), infer_shape=_psroi_infer)
+
+
+def _clamped_bilinear(img, gx, gy):
+    """Bilinear sample with clamped coords + ±0.5-border zero mask
+    (deformable_psroi_pooling.cu:40-68,146-152). img (C,H,W)."""
+    c, h, w = img.shape
+    ok = (gx >= -0.5) & (gx <= w - 0.5) & (gy >= -0.5) & (gy <= h - 0.5)
+    gx = jnp.clip(gx, 0.0, w - 1.0)
+    gy = jnp.clip(gy, 0.0, h - 1.0)
+    x1 = jnp.floor(gx)
+    y1 = jnp.floor(gy)
+    dx = gx - x1
+    dy = gy - y1
+    x1i = x1.astype(jnp.int32)
+    y1i = y1.astype(jnp.int32)
+    x2i = jnp.minimum(x1i + 1, w - 1)
+    y2i = jnp.minimum(y1i + 1, h - 1)
+    v11 = img[:, y1i, x1i]
+    v12 = img[:, y2i, x1i]
+    v21 = img[:, y1i, x2i]
+    v22 = img[:, y2i, x2i]
+    val = ((1 - dx) * (1 - dy) * v11 + (1 - dx) * dy * v12 +
+           dx * (1 - dy) * v21 + dx * dy * v22)
+    return val, ok
+
+
+def _deformable_conv(attrs, octx, data, offset, weight, bias=None):
+    kh, kw = attrs["kernel"]
+    sh, sw = attrs["stride"] or (1, 1)
+    dh, dw = attrs["dilate"] or (1, 1)
+    ph, pw = attrs["pad"] or (0, 0)
+    ng = attrs["num_group"]
+    ndg = attrs["num_deformable_group"]
+    n, cin, h, w = data.shape
+    nf = attrs["num_filter"]
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cpg = cin // ndg                      # channels per deformable group
+
+    oy = jnp.arange(oh, dtype=data.dtype) * sh - ph
+    ox = jnp.arange(ow, dtype=data.dtype) * sw - pw
+
+    def one(img, off):
+        # off: (ndg*2*kh*kw, oh, ow); per kernel tap (i,j): (dy, dx) pair
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                tap = 2 * (i * kw + j)
+                vals = []
+                for gidx in range(ndg):
+                    dy = off[gidx * 2 * kh * kw + tap]
+                    dx = off[gidx * 2 * kh * kw + tap + 1]
+                    gy = oy[:, None] + i * dh + dy
+                    gx = ox[None, :] + j * dw + dx
+                    v, ok = _clamped_bilinear(
+                        img[gidx * cpg:(gidx + 1) * cpg], gx, gy)
+                    # zero padding outside (im2col semantics)
+                    vals.append(jnp.where(ok[None], v, 0.0))
+                cols.append(jnp.concatenate(vals, axis=0))  # (cin,oh,ow)
+        return jnp.stack(cols, axis=1)                      # (cin,kh*kw,oh,ow)
+
+    cols = jax.vmap(one)(data, offset)                      # (N,cin,K2,oh,ow)
+    wmat = weight.reshape(ng, nf // ng, (cin // ng) * kh * kw)
+    cols = cols.reshape(n, ng, (cin // ng) * kh * kw, oh * ow)
+    out = jnp.einsum("gfk,ngko->ngfo", wmat, cols).reshape(n, nf, oh, ow)
+    if not attrs["no_bias"]:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return _t(out)
+
+
+def _deformable_conv_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    nf = attrs["num_filter"]
+    kh, kw = attrs["kernel"]
+    if ds is not None:
+        in_shapes = list(in_shapes)
+        ng = attrs["num_group"]
+        if in_shapes[2] is None:
+            in_shapes[2] = (nf, ds[1] // ng, kh, kw)
+        if len(in_shapes) > 3 and in_shapes[3] is None:
+            in_shapes[3] = (nf,)
+    if ds is None:
+        return in_shapes, [None]
+    sh, sw = attrs["stride"] or (1, 1)
+    dh, dw = attrs["dilate"] or (1, 1)
+    ph, pw = attrs["pad"] or (0, 0)
+    oh = (ds[2] + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (ds[3] + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    return in_shapes, [(ds[0], nf, oh, ow)]
+
+
+def _deform_conv_inputs(attrs):
+    base = ["data", "offset", "weight"]
+    return base if attrs["no_bias"] else base + ["bias"]
+
+
+_dconv_schema = register(
+    "_contrib_DeformableConvolution", _deformable_conv,
+    params={"kernel": Param("shape", None, True),
+            "stride": Param("shape", None),
+            "dilate": Param("shape", None),
+            "pad": Param("shape", None),
+            "num_filter": Param("int", None, True),
+            "num_group": Param("int", 1),
+            "num_deformable_group": Param("int", 1),
+            "workspace": Param("int", 1024),
+            "no_bias": Param("bool", False),
+            "layout": Param("str", None)},
+    inputs=("data", "offset", "weight", "bias"),
+    infer_shape=_deformable_conv_infer)
+_dconv_schema.list_inputs = _deform_conv_inputs  # type: ignore
+_dconv_schema.num_inputs = lambda attrs: len(_deform_conv_inputs(attrs))  # type: ignore
+
+
+def _deformable_psroi_pooling(attrs, octx, data, rois, trans=None):
+    scale = attrs["spatial_scale"]
+    od = attrs["output_dim"]
+    p = attrs["pooled_size"]
+    g = attrs["group_size"]
+    part = attrs["part_size"] or p
+    sp = attrs["sample_per_part"]
+    tstd = attrs["trans_std"]
+    no_trans = attrs["no_trans"] or trans is None
+    n, channels, h, w = data.shape
+    if not no_trans:
+        num_cls = trans.shape[1] // 2
+    else:
+        num_cls = 1
+    cpc = od // num_cls                     # channels_each_class
+    ghi = gwi = _psroi_channel_maps(p, g)
+    parth = _np.floor(_np.arange(p) / p * part).astype(_np.int32)
+
+    def one_roi(roi, tr):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, n - 1)
+        x1 = _round_half_away(roi[1]) * scale - 0.5
+        y1 = _round_half_away(roi[2]) * scale - 0.5
+        x2 = (_round_half_away(roi[3]) + 1.0) * scale - 0.5
+        y2 = (_round_half_away(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        sbh, sbw = bh / sp, bw / sp
+        img = data[bidx].reshape(od, g, g, h, w)
+
+        def one_bin(ph_i, pw_i):
+            gh, gw = ghi[ph_i], gwi[pw_i]
+            chans = img[:, gh, gw]                          # (od,H,W)
+            if no_trans:
+                tx = ty = jnp.asarray(0.0, data.dtype)
+                tx = jnp.broadcast_to(tx, (od,))
+                ty = jnp.broadcast_to(ty, (od,))
+            else:
+                cls_id = jnp.arange(od) // cpc              # (od,)
+                pth, ptw = parth[ph_i], parth[pw_i]
+                tx = tr[cls_id * 2, pth, ptw] * tstd
+                ty = tr[cls_id * 2 + 1, pth, ptw] * tstd
+            hstart = ph_i * bh + y1 + ty * rh               # (od,)
+            wstart = pw_i * bw + x1 + tx * rw
+            ih = jnp.arange(sp, dtype=data.dtype)
+            gy = hstart[:, None, None] + ih[:, None] * sbh  # (od,sp,1)
+            gx = wstart[:, None, None] + ih[None, :] * sbw  # (od,1,sp)
+            gy = jnp.broadcast_to(gy, (od, sp, sp))
+            gx = jnp.broadcast_to(gx, (od, sp, sp))
+            vals, ok = jax.vmap(
+                lambda c, yy, xx: _clamped_bilinear(c[None], xx, yy))(
+                chans, gy, gx)
+            vals = vals[:, 0]                               # (od,sp,sp)
+            cnt = jnp.sum(ok, axis=(1, 2))
+            tot = jnp.sum(jnp.where(ok, vals, 0.0), axis=(1, 2))
+            return jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1), 0.0)
+
+        rows = [jnp.stack([one_bin(i, j) for j in range(p)], axis=-1)
+                for i in range(p)]
+        return jnp.stack(rows, axis=-2)                     # (od,p,p)
+
+    if no_trans:
+        tr_dummy = jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+        out = jax.vmap(one_roi)(rois, tr_dummy)
+    else:
+        # trans is per-roi (R, 2*num_cls, part, part) in the reference's
+        # R-FCN usage (one trans map per roi)
+        out = jax.vmap(one_roi)(rois, trans)
+    return _t(out)
+
+
+def _deform_psroi_inputs(attrs):
+    return ["data", "rois"] if attrs["no_trans"] else \
+        ["data", "rois", "trans"]
+
+
+def _deform_psroi_infer(attrs, in_shapes):
+    ds, rs = in_shapes[0], in_shapes[1]
+    if ds is None or rs is None:
+        return in_shapes, [None]
+    p = attrs["pooled_size"]
+    return in_shapes, [(rs[0], attrs["output_dim"], p, p)]
+
+
+_dpsroi_schema = register(
+    "_contrib_DeformablePSROIPooling", _deformable_psroi_pooling,
+    params={"spatial_scale": Param("float", None, True),
+            "output_dim": Param("int", None, True),
+            "group_size": Param("int", None, True),
+            "pooled_size": Param("int", None, True),
+            "part_size": Param("int", 0),
+            "sample_per_part": Param("int", 1),
+            "trans_std": Param("float", 0.0),
+            "no_trans": Param("bool", False)},
+    inputs=("data", "rois", "trans"), infer_shape=_deform_psroi_infer)
+_dpsroi_schema.list_inputs = _deform_psroi_inputs  # type: ignore
+_dpsroi_schema.num_inputs = lambda attrs: len(_deform_psroi_inputs(attrs))  # type: ignore
+
+
+# ---------------------------------------------------------------------------
+# legacy Crop (src/operator/crop-inl.h) — crop spatial dims to h_w or to a
+# reference input's size, from offset or center
+# ---------------------------------------------------------------------------
+
+def _crop_op(attrs, octx, data, crop_like=None):
+    n, c, h, w = data.shape
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = attrs["h_w"]
+    if th <= 0 or tw <= 0:
+        raise MXNetError("Crop: need h_w or a second (crop_like) input")
+    if attrs["center_crop"]:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = attrs["offset"]
+    if oy + th > h or ox + tw > w:
+        raise MXNetError(f"Crop: crop window ({oy}:{oy+th},{ox}:{ox+tw}) "
+                         f"exceeds input ({h},{w})")
+    return _t(jax.lax.slice(data, (0, 0, oy, ox), (n, c, oy + th, ox + tw)))
+
+
+def _crop_op_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    if ds is None:
+        return in_shapes, [None]
+    if len(in_shapes) > 1 and in_shapes[1] is not None:
+        th, tw = in_shapes[1][2], in_shapes[1][3]
+    else:
+        th, tw = attrs["h_w"]
+    return in_shapes, [(ds[0], ds[1], th, tw)]
+
+
+_crop_schema = register(
+    "Crop", _crop_op,
+    params={"num_args": Param("int", 1),
+            "offset": Param("shape", (0, 0)),
+            "h_w": Param("shape", (0, 0)),
+            "center_crop": Param("bool", False)},
+    inputs=("data", "crop_like"), infer_shape=_crop_op_infer)
+_crop_schema.list_inputs = lambda attrs: (
+    ["data", "crop_like"] if attrs["num_args"] == 2 else ["data"])
+_crop_schema.num_inputs = lambda attrs: attrs["num_args"]

@@ -513,11 +513,12 @@ def test_count_sketch():
 
 
 def test_khatri_rao():
-    a = nd([[1.0, -1.0], [2.0, -3.0]])
-    b = nd([[1.0, 4.0], [2.0, 5.0]])
-    out = mx.nd.khatri_rao(a, b).asnumpy()
-    want = np.stack([np.kron(a.asnumpy()[i], b.asnumpy()[i])
-                     for i in range(2)])
+    # column-wise Khatri-Rao (krprod.cc): shared column count, rows kron
+    a = np.array([[1.0, -1.0], [2.0, -3.0]])
+    b = np.array([[1.0, 4.0], [2.0, 5.0], [3.0, 6.0]])
+    out = mx.nd.khatri_rao(nd(a), nd(b)).asnumpy()
+    assert out.shape == (6, 2)
+    want = np.stack([np.kron(a[:, c], b[:, c]) for c in range(2)], axis=1)
     np.testing.assert_allclose(out, want)
 
 
@@ -537,3 +538,122 @@ def test_contrib_symbolic_compose():
     ex = anchors.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
     out = ex.forward()[0]
     assert out.shape == (1, 8 * 8 * 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# R-CNN family: Proposal / PSROIPooling / DeformableConvolution / Crop
+# ---------------------------------------------------------------------------
+
+def test_proposal_shapes_and_order():
+    rng = np.random.RandomState(0)
+    A, H, W = 3, 4, 4
+    cls_prob = rng.uniform(0.1, 1, (1, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.normal(size=(1, 4 * A, H, W)) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois, scores = mx.nd.contrib.Proposal(
+        nd(cls_prob), nd(bbox_pred), nd(im_info), feature_stride=16,
+        scales=(8,), ratios=(0.5, 1, 2), rpn_pre_nms_top_n=20,
+        rpn_post_nms_top_n=8, threshold=0.7, rpn_min_size=4)
+    assert rois.shape == (8, 5)
+    assert scores.shape == (8, 1)
+    r = rois.asnumpy()
+    s = scores.asnumpy()[:, 0]
+    assert (r[:, 0] == 0).all()
+    # top score first; short NMS output pads by cycling kept proposals
+    assert s[0] == s.max()
+    nkept = len(np.unique(s))
+    np.testing.assert_allclose(s[:nkept], np.sort(s[:nkept])[::-1])
+    np.testing.assert_allclose(s, np.tile(s[:nkept], 3)[:len(s)])
+    assert r[:, 1:].min() >= 0 and r[:, 1:].max() <= 63
+
+
+def test_multi_proposal_batch():
+    rng = np.random.RandomState(1)
+    A, H, W, N = 2, 3, 3, 2
+    cls_prob = rng.uniform(0.1, 1, (N, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.normal(size=(N, 4 * A, H, W)) * 0.1).astype(np.float32)
+    im_info = np.tile([48.0, 48.0, 1.0], (N, 1)).astype(np.float32)
+    rois, scores = mx.nd.contrib.MultiProposal(
+        nd(cls_prob), nd(bbox_pred), nd(im_info), feature_stride=16,
+        scales=(8,), ratios=(1.0, 2.0), rpn_pre_nms_top_n=10,
+        rpn_post_nms_top_n=4, rpn_min_size=2)
+    assert rois.shape == (N * 4, 5)
+    r = rois.asnumpy()
+    assert (r[:4, 0] == 0).all() and (r[4:, 0] == 1).all()
+
+
+def test_psroi_pooling():
+    # output_dim=2, group 2, pooled 2: each output channel/bin reads its own
+    # channel group; constant-valued channels make the oracle trivial
+    od, g, h, w = 2, 2, 8, 8
+    data = np.zeros((1, od * g * g, h, w), np.float32)
+    for c in range(od * g * g):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = mx.nd.contrib.PSROIPooling(nd(data), nd(rois), spatial_scale=1.0,
+                                     output_dim=od, pooled_size=g,
+                                     group_size=g).asnumpy()
+    assert out.shape == (1, od, g, g)
+    for ct in range(od):
+        for gh in range(g):
+            for gw in range(g):
+                assert out[0, ct, gh, gw] == (ct * g + gh) * g + gw
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(3)
+    data = rng.normal(size=(2, 4, 7, 7)).astype(np.float32)
+    weight = rng.normal(size=(6, 4, 3, 3)).astype(np.float32) * 0.2
+    bias = rng.normal(size=(6,)).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    got = mx.nd.contrib.DeformableConvolution(
+        nd(data), nd(offset), nd(weight), nd(bias), kernel=(3, 3),
+        num_filter=6).asnumpy()
+    want = mx.nd.Convolution(nd(data), nd(weight), nd(bias), kernel=(3, 3),
+                             num_filter=6).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_integer_shift():
+    # offset of exactly (0, 1) shifts sampling one pixel right
+    rng = np.random.RandomState(4)
+    data = rng.normal(size=(1, 2, 6, 7)).astype(np.float32)
+    weight = rng.normal(size=(3, 2, 1, 1)).astype(np.float32)
+    offset = np.zeros((1, 2, 6, 7), np.float32)
+    offset[:, 1] = 1.0          # dx = 1
+    got = mx.nd.contrib.DeformableConvolution(
+        nd(data), nd(offset), nd(weight), kernel=(1, 1), num_filter=3,
+        no_bias=True).asnumpy()
+    want = mx.nd.Convolution(nd(data[:, :, :, 1:]), nd(weight), kernel=(1, 1),
+                             num_filter=3, no_bias=True).asnumpy()
+    np.testing.assert_allclose(got[:, :, :, :6], want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    od, g, p = 2, 2, 2
+    rng = np.random.RandomState(5)
+    data = rng.normal(size=(1, od * g * g, 8, 8)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        nd(data), nd(rois), spatial_scale=1.0, output_dim=od, group_size=g,
+        pooled_size=p, sample_per_part=2, no_trans=True)
+    assert out.shape == (1, od, p, p)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_crop():
+    data = nd(np.arange(2 * 3 * 6 * 8, dtype=np.float32).reshape(2, 3, 6, 8))
+    out = mx.nd.Crop(data, offset=(1, 2), h_w=(3, 4), num_args=1).asnumpy()
+    np.testing.assert_array_equal(out,
+                                  data.asnumpy()[:, :, 1:4, 2:6])
+    like = nd(np.zeros((2, 1, 4, 4)))
+    out2 = mx.nd.Crop(data, like, num_args=2, center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out2, data.asnumpy()[:, :, 1:5, 2:6])
+
+
+def test_crop_symbolic():
+    d = mx.sym.Variable("d")
+    ref = mx.sym.Variable("r")
+    c = mx.sym.Crop(d, ref, num_args=2)
+    ex = c.simple_bind(mx.cpu(), d=(1, 2, 8, 8), r=(1, 2, 5, 5))
+    assert ex.forward()[0].shape == (1, 2, 5, 5)

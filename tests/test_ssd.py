@@ -1,0 +1,121 @@
+"""SSD training-step slice (build-plan stage 10; reference example/ssd).
+
+A scaled-down SSD-VGG-style network: VGG-ish conv backbone, two feature
+scales, MultiBoxPrior anchors, MultiBoxTarget assignment, joint
+SoftmaxOutput + smooth-L1 MakeLoss training through Module, then
+MultiBoxDetection decode.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_ssd(num_classes=3, num_anchors=3):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+
+    # mini-VGG backbone: two conv blocks (example/ssd/symbol/vgg16_reduced.py
+    # role)
+    def block(x, nf, name):
+        x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                               name=f"{name}_conv")
+        x = mx.sym.Activation(x, act_type="relu")
+        return mx.sym.Pooling(x, pool_type="max", kernel=(2, 2),
+                              stride=(2, 2))
+
+    f1 = block(data, 16, "b1")          # /2
+    f1 = block(f1, 32, "b2")            # /4
+    f2 = block(f1, 32, "b3")            # /8
+
+    feats = [(f1, (0.2, 0.35)), (f2, (0.4, 0.6))]
+    anchors_list, cls_list, loc_list = [], [], []
+    for i, (feat, sizes) in enumerate(feats):
+        anchors_list.append(mx.sym.contrib.MultiBoxPrior(
+            feat, sizes=sizes, ratios=(1.0, 2.0), clip=True))
+        cp = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                num_filter=(num_classes + 1) * num_anchors,
+                                name=f"clshead{i}")
+        cp = mx.sym.transpose(cp, axes=(0, 2, 3, 1))
+        cls_list.append(mx.sym.reshape(cp, shape=(0, -1, num_classes + 1)))
+        lp = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                num_filter=4 * num_anchors,
+                                name=f"lochead{i}")
+        lp = mx.sym.transpose(lp, axes=(0, 2, 3, 1))
+        loc_list.append(mx.sym.Flatten(lp))
+
+    anchors = mx.sym.Concat(*anchors_list, dim=1)
+    cls_pred = mx.sym.transpose(mx.sym.Concat(*cls_list, dim=1),
+                                axes=(0, 2, 1))
+    loc_pred = mx.sym.Concat(*loc_list, dim=1)
+
+    tgt = mx.sym.contrib.MultiBoxTarget(anchors, label, cls_pred,
+                                        overlap_threshold=0.5,
+                                        negative_mining_ratio=3.0,
+                                        negative_mining_thresh=0.5)
+    loc_target, loc_mask, cls_target = tgt[0], tgt[1], tgt[2]
+    cls_prob = mx.sym.SoftmaxOutput(cls_pred, cls_target, multi_output=True,
+                                    use_ignore=True, ignore_label=-1,
+                                    normalization="valid", name="cls_prob")
+    loc_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(loc_mask * (loc_pred - loc_target), scalar=1.0),
+        grad_scale=1.0, name="loc_loss")
+    return mx.sym.Group([cls_prob, loc_loss, mx.sym.BlockGrad(cls_target),
+                         mx.sym.BlockGrad(anchors),
+                         mx.sym.BlockGrad(loc_pred)])
+
+
+def make_batch(rng, b, num_classes):
+    labels = np.zeros((b, 2, 5), np.float32)
+    labels[:, 1] = -1
+    for i in range(b):
+        x1, y1 = rng.uniform(0.05, 0.45, 2)
+        labels[i, 0] = [i % num_classes, x1, y1, x1 + rng.uniform(0.2, 0.4),
+                        y1 + rng.uniform(0.2, 0.4)]
+    images = rng.uniform(-1, 1, (b, 3, 32, 32)).astype(np.float32)
+    return images, labels
+
+
+def test_ssd_train_step_and_decode():
+    rng = np.random.RandomState(0)
+    b, ncls = 4, 3
+    net = build_ssd(ncls)
+    images, labels = make_batch(rng, b, ncls)
+
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (b, 3, 32, 32))],
+             label_shapes=[("label", (b, 2, 5))])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / b})
+
+    batch = mx.io.DataBatch(data=[mx.nd.array(images)],
+                            label=[mx.nd.array(labels)])
+    nlls = []
+    for _ in range(12):
+        mod.forward(batch, is_train=True)
+        outs = mod.get_outputs()
+        cls_prob = outs[0].asnumpy()
+        cls_tgt = outs[2].asnumpy()
+        mask = cls_tgt >= 0
+        idx = np.clip(cls_tgt.astype(int), 0, ncls)
+        picked = np.take_along_axis(cls_prob, idx[:, None, :], axis=1)[:, 0]
+        nlls.append(-(np.log(np.maximum(picked, 1e-12)) * mask).sum()
+                    / max(mask.sum(), 1))
+        mod.backward()
+        mod.update()
+    assert nlls[-1] < nlls[0], f"ssd loss not improving: {nlls}"
+
+    # decode path: detections on the trained model
+    outs = mod.get_outputs()
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(outs[0].asnumpy()), mx.nd.array(outs[4].asnumpy()),
+        mx.nd.array(outs[3].asnumpy()[:1]), threshold=0.01,
+        nms_threshold=0.45, nms_topk=10)
+    d = det.asnumpy()
+    assert d.shape[0] == b and d.shape[2] == 6
+    valid = d[d[:, :, 0] >= 0]
+    assert len(valid) > 0
+    assert (valid[:, 1] >= 0.01).all()
