@@ -42,6 +42,7 @@ from . import recordio
 from . import kvstore
 from . import kvstore as kv
 from . import monitor
+from . import contrib
 from .monitor import Monitor
 from . import module
 from . import module as mod

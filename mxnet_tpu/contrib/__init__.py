@@ -1,0 +1,5 @@
+"""mx.contrib — experimental python subsystems.
+
+Parity target: python/mxnet/contrib/ (SURVEY.md §2.4 "contrib py").
+"""
+from . import quantization  # noqa: F401

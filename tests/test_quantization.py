@@ -1,0 +1,234 @@
+"""INT8 quantization tests.
+
+Reference pattern: tests/python/quantization/test_quantization.py —
+op-level parity against fp32 + quantize_model graph-pass checks.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as qz
+
+
+def nd(x, dtype=np.float32):
+    return mx.nd.array(np.asarray(x, dtype=dtype))
+
+
+def quantize_int8(x):
+    """Oracle: symmetric int8 quantization."""
+    real = np.max(np.abs(x))
+    q = np.sign(x) * np.minimum(np.abs(x) * (127.0 / real) + 0.5, 127.0)
+    return np.trunc(q).astype(np.int8), real
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 2, (4, 7)).astype(np.float32)
+    q, mn, mx_ = mx.nd.contrib.quantize(nd(x), nd(x.min()), nd(x.max()),
+                                        out_type="int8")
+    assert q.dtype == np.int8
+    want_q, real = quantize_int8(x)
+    np.testing.assert_array_equal(q.asnumpy(), want_q)
+    np.testing.assert_allclose(mx_.asnumpy(), real, rtol=1e-6)
+    back = mx.nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    # max quantization error is half a level
+    np.testing.assert_allclose(back, x, atol=real / 127.0)
+
+
+def test_quantize_uint8():
+    x = np.array([[0.0, 0.5, 1.0]], np.float32)
+    q, mn, mx_ = mx.nd.contrib.quantize(nd(x), nd(0.0), nd(1.0),
+                                        out_type="uint8")
+    np.testing.assert_array_equal(q.asnumpy(), [[0, 128, 255]])
+    back = mx.nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    np.testing.assert_allclose(back, x, atol=1.0 / 255)
+
+
+def test_requantize():
+    rng = np.random.RandomState(1)
+    f = rng.normal(0, 100, (3, 5)).astype(np.float32)
+    real_in = float(np.max(np.abs(f)) * 4)
+    x32 = np.round(f / real_in * (2**31 - 1)).astype(np.int32)
+    q, mn, mx_ = mx.nd.contrib.requantize(mx.nd.array(x32, dtype=np.int32),
+                                          nd(-real_in), nd(real_in))
+    back = q.asnumpy().astype(np.float32) * (mx_.asnumpy() / 127.0)
+    np.testing.assert_allclose(back, f, atol=np.abs(f).max() / 127 + 1e-3)
+
+
+def test_quantized_fc_matches_fp32():
+    rng = np.random.RandomState(2)
+    x = rng.normal(0, 1, (4, 16)).astype(np.float32)
+    w = rng.normal(0, 0.5, (8, 16)).astype(np.float32)
+    b = rng.normal(0, 0.5, (8,)).astype(np.float32)
+    qx, xr = quantize_int8(x)
+    qw, wr = quantize_int8(w)
+    qb, br = quantize_int8(b)
+    out32, mn, mx_ = mx.nd.contrib.quantized_fully_connected(
+        mx.nd.array(qx, dtype=np.int8), mx.nd.array(qw, dtype=np.int8),
+        mx.nd.array(qb, dtype=np.int8),
+        nd(-xr), nd(xr), nd(-wr), nd(wr), nd(-br), nd(br), num_hidden=8)
+    assert out32.dtype == np.int32
+    f = mx.nd.contrib.dequantize(out32, mn, mx_).asnumpy()
+    want = x @ w.T + b
+    np.testing.assert_allclose(f, want, atol=0.15, rtol=0.1)
+
+
+def test_quantized_conv_matches_fp32():
+    rng = np.random.RandomState(3)
+    x = rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(0, 0.3, (4, 3, 3, 3)).astype(np.float32)
+    qx, xr = quantize_int8(x)
+    qw, wr = quantize_int8(w)
+    out32, mn, mx_ = mx.nd.contrib.quantized_conv(
+        mx.nd.array(qx, dtype=np.int8), mx.nd.array(qw, dtype=np.int8),
+        nd(-xr), nd(xr), nd(-wr), nd(wr), kernel=(3, 3), num_filter=4,
+        pad=(1, 1), no_bias=True)
+    f = mx.nd.contrib.dequantize(out32, mn, mx_).asnumpy()
+    want = mx.nd.Convolution(nd(x), nd(w), kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), no_bias=True).asnumpy()
+    np.testing.assert_allclose(f, want, atol=0.3, rtol=0.1)
+
+
+def test_quantized_pooling_flatten():
+    rng = np.random.RandomState(4)
+    qx = rng.randint(-127, 128, (1, 2, 4, 4)).astype(np.int8)
+    out, mn, mx_ = mx.nd.contrib.quantized_pooling(
+        mx.nd.array(qx, dtype=np.int8), nd(-1.0), nd(1.0),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    want = qx.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_array_equal(out.asnumpy(), want)
+    fl, _, _ = mx.nd.contrib.quantized_flatten(
+        mx.nd.array(qx, dtype=np.int8), nd(-1.0), nd(1.0))
+    assert fl.shape == (1, 32)
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    a1 = mx.sym.Activation(f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, num_hidden=8, name="fc2")
+    return mx.sym.softmax(f2, name="out")
+
+
+def _conv_sym():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="pool1")
+    fl = mx.sym.Flatten(p1, name="flat")
+    f1 = mx.sym.FullyConnected(fl, num_hidden=10, name="fc1")
+    return mx.sym.softmax(f1, name="out")
+
+
+def _init_params(sym, data_shape, seed=0):
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = sym.infer_shape(data=data_shape)
+    args = {}
+    for name, s in zip(sym.list_arguments(), shapes):
+        if name == "data":
+            continue
+        args[name] = mx.nd.array(rng.normal(0, 0.2, s).astype(np.float32))
+    return args
+
+
+def _fp32_outputs(sym, args, x):
+    ex = sym.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    for k, v in args.items():
+        ex.arg_dict[k][:] = v
+    ex.arg_dict["data"][:] = x
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _int8_outputs(qsym, qargs, x):
+    ex = qsym.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    for k, v in qargs.items():
+        ex.arg_dict[k][:] = v
+    ex.arg_dict["data"][:] = x
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_quantize_symbol_structure():
+    sym = _mlp_sym()
+    qsym = qz._quantize_symbol(sym, offline_params={"fc1_weight",
+                                                    "fc1_bias"})
+    ops = [n.op.name for n in qsym._topo() if n.op is not None]
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_requantize" in ops
+    assert "_contrib_dequantize" in ops
+    args = qsym.list_arguments()
+    assert "fc1_weight_quantize" in args
+    assert "fc1_weight_quantize_min" in args
+
+
+def test_quantize_model_mlp_tracks_fp32():
+    rng = np.random.RandomState(5)
+    sym = _mlp_sym()
+    args = _init_params(sym, (8, 16))
+    x = rng.normal(0, 1, (8, 16)).astype(np.float32)
+    calib = mx.io.NDArrayIter(x, batch_size=4, label_name=None)
+    for mode in ("none", "naive", "entropy"):
+        qsym, qargs, _ = qz.quantize_model(
+            sym, args, {}, ctx=mx.cpu(), calib_mode=mode,
+            calib_data=(calib if mode != "none" else None),
+            num_calib_examples=8)
+        got = _int8_outputs(qsym, qargs, x)
+        want = _fp32_outputs(sym, args, x)
+        assert np.abs(got - want).max() < 0.1, \
+            f"calib_mode={mode}: max err {np.abs(got - want).max()}"
+        # classification decisions should essentially agree
+        agree = (got.argmax(1) == want.argmax(1)).mean()
+        assert agree >= 0.9, f"calib_mode={mode}: agreement {agree}"
+
+
+def test_quantize_model_conv_tracks_fp32():
+    rng = np.random.RandomState(6)
+    sym = _conv_sym()
+    args = _init_params(sym, (4, 3, 8, 8))
+    x = rng.normal(0, 1, (4, 3, 8, 8)).astype(np.float32)
+    calib = mx.io.NDArrayIter(x, batch_size=2, label_name=None)
+    qsym, qargs, _ = qz.quantize_model(
+        sym, args, {}, ctx=mx.cpu(), calib_mode="naive", calib_data=calib,
+        num_calib_examples=4)
+    got = _int8_outputs(qsym, qargs, x)
+    want = _fp32_outputs(sym, args, x)
+    assert np.abs(got - want).max() < 0.1
+    assert (got.argmax(1) == want.argmax(1)).mean() >= 0.75
+
+
+def test_quantize_model_excluded_layer():
+    sym = _mlp_sym()
+    qsym = qz._quantize_symbol(sym, excluded_symbols={"fc2"})
+    names = [n.name for n in qsym._topo() if n.op is not None]
+    assert "fc2" in names
+    assert "quantized_fc1" in names
+
+
+def test_quantized_pooling_global_and_avg():
+    rng = np.random.RandomState(7)
+    qx = rng.randint(-127, 128, (2, 3, 4, 4)).astype(np.int8)
+    out, _, _ = mx.nd.contrib.quantized_pooling(
+        mx.nd.array(qx, dtype=np.int8), nd(-1.0), nd(1.0),
+        global_pool=True, pool_type="max")
+    np.testing.assert_array_equal(out.asnumpy()[:, :, 0, 0], qx.max((2, 3)))
+    avg, _, _ = mx.nd.contrib.quantized_pooling(
+        mx.nd.array(qx, dtype=np.int8), nd(-1.0), nd(1.0),
+        kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    want = np.round(qx.reshape(2, 3, 2, 2, 2, 2).mean((3, 5)))
+    np.testing.assert_allclose(avg.asnumpy(), want)
+
+
+def test_quantize_model_global_pool_net():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           name="c1")
+    p = mx.sym.Pooling(c, global_pool=True, pool_type="avg", name="gp")
+    f = mx.sym.FullyConnected(mx.sym.Flatten(p), num_hidden=3, name="fc")
+    sym = mx.sym.softmax(f)
+    args = _init_params(sym, (2, 3, 8, 8), seed=9)
+    x = np.random.RandomState(9).normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    qsym, qargs, _ = qz.quantize_model(sym, args, {}, ctx=mx.cpu(),
+                                       calib_mode="none")
+    got = _int8_outputs(qsym, qargs, x)
+    want = _fp32_outputs(sym, args, x)
+    assert np.abs(got - want).max() < 0.12
