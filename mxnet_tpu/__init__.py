@@ -43,6 +43,12 @@ from . import kvstore
 from . import kvstore as kv
 from . import monitor
 from . import contrib
+from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import config
+from . import operator
+config._apply_startup()
 from .monitor import Monitor
 from . import module
 from . import module as mod

@@ -1,0 +1,87 @@
+"""MXNET_* environment-variable config surface.
+
+Parity target: docs/faq/env_var.md — the reference reads ~29 `MXNET_*` env
+vars via dmlc::GetEnv at use sites (engine threads
+threaded_engine_perdevice.cc:77-78, bulk exec graph_executor.cc:1351-1354,
+mem pool pooled_storage_manager.h:54, kvstore bound kvstore_dist.h:58).
+
+Here every documented var is *accepted* and surfaced through `get()`; vars
+with a live TPU-stack meaning act (table below), the rest are recorded
+no-ops because XLA/PJRT owns the concern:
+
+  MXNET_ENGINE_TYPE            -> engine.set_engine_type (NaiveEngine = sync)
+  MXNET_PROFILER_AUTOSTART     -> profiler.set_state('run') at import
+  MXNET_KVSTORE_BIGARRAY_BOUND -> kvstore key-sharding threshold
+  MXNET_EXEC_BULK_EXEC_*       -> engine.set_bulk_size hint (XLA fuses anyway)
+  MXNET_ENFORCE_DETERMINISM    -> jax default; recorded
+  MXNET_CPU_WORKER_NTHREADS /
+  MXNET_GPU_WORKER_NTHREADS    -> XLA owns threading; recorded
+  MXNET_GPU_MEM_POOL_RESERVE   -> PJRT preallocation owns HBM; recorded
+  MXNET_EXEC_INPLACE_GRAD_SUM_CAP, MXNET_CUDNN_AUTOTUNE_DEFAULT, ...
+                               -> absorbed by XLA buffer assignment/autotune
+"""
+from __future__ import annotations
+
+import os
+
+_DOCUMENTED = {
+    "MXNET_ENGINE_TYPE": "ThreadedEnginePerDevice",
+    "MXNET_CPU_WORKER_NTHREADS": 1,
+    "MXNET_CPU_PRIORITY_NTHREADS": 4,
+    "MXNET_CPU_NNPACK_NTHREADS": 4,
+    "MXNET_GPU_WORKER_NTHREADS": 2,
+    "MXNET_GPU_COPY_NTHREADS": 1,
+    "MXNET_OMP_MAX_THREADS": None,
+    "MXNET_EXEC_NUM_TEMP": 1,
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": 8,
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": 1,
+    "MXNET_EXEC_BULK_EXEC_TRAIN": 1,
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": 15,
+    "MXNET_GPU_MEM_POOL_RESERVE": 5,
+    "MXNET_GPU_MEM_POOL_TYPE": "Naive",
+    "MXNET_ENFORCE_DETERMINISM": 0,
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": 4,
+    "MXNET_KVSTORE_BIGARRAY_BOUND": 1000000,
+    "MXNET_KVSTORE_USETREE": 0,
+    "MXNET_ENABLE_GPU_P2P": 1,
+    "MXNET_UPDATE_ON_KVSTORE": 1,
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": 1,
+    "MXNET_CUDNN_LIB_CHECKING": 1,
+    "MXNET_MKLDNN_ENABLED": 1,
+    "MXNET_MKLDNN_CACHE_NUM": -1,
+    "MXNET_PROFILER_AUTOSTART": 0,
+    "MXNET_PROFILER_MODE": 0,
+    "MXNET_DUMP_PROFILE": 0,
+    "MXNET_BACKWARD_DO_MIRROR": 0,
+    "MXNET_USE_FUSION": 1,
+}
+
+
+def get(name, default=None):
+    """Read an MXNET_* var with its documented default."""
+    if default is None:
+        default = _DOCUMENTED.get(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, int):
+        try:
+            return int(raw)
+        except ValueError:
+            return default
+    return raw
+
+
+def list_vars():
+    """All documented vars with their effective values."""
+    return {k: get(k) for k in sorted(_DOCUMENTED)}
+
+
+def _apply_startup():
+    """Honor vars that have a live meaning (called at package import)."""
+    from . import engine
+    engine.set_engine_type(get("MXNET_ENGINE_TYPE"))
+    engine.set_bulk_size(get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN"))
+    if get("MXNET_PROFILER_AUTOSTART"):
+        from . import profiler
+        profiler.set_state("run")

@@ -10,8 +10,23 @@ reference scripts run; real batching is done by jit fusion.
 from __future__ import annotations
 
 import contextlib
+import os
 
 _bulk_size = 0
+
+# NaiveEngine parity: MXNET_ENGINE_TYPE=NaiveEngine (src/engine/engine.cc:32)
+# forces synchronous op execution — every imperative op blocks until its
+# buffers are ready. Debug/determinism aid; XLA results are deterministic
+# either way, this pins *completion order* too. Set from the env var by
+# config._apply_startup() at package import.
+_sync_mode = False
+
+
+def set_engine_type(name):
+    """'NaiveEngine' -> synchronous; 'ThreadedEngine'/'ThreadedEnginePerDevice'
+    -> async (XLA default dispatch)."""
+    global _sync_mode
+    _sync_mode = (name == "NaiveEngine")
 
 
 def set_bulk_size(size: int) -> int:

@@ -211,6 +211,14 @@ class Executor:
         return tuple(out)
 
     def forward(self, is_train=False, **kwargs):
+        from . import profiler
+        if profiler.symbolic_enabled():
+            return profiler.profile_op(
+                f"Forward({self._symbol.name or 'graph'})",
+                lambda: self._forward_impl(is_train, **kwargs))
+        return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         from .ndarray.ndarray import NDArray
         from . import random as _random
         dev = self._ctx.jax_device()
@@ -387,6 +395,14 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
+        from . import profiler
+        if profiler.symbolic_enabled():
+            return profiler.profile_op(
+                f"Backward({self._symbol.name or 'graph'})",
+                lambda: self._backward_impl(out_grads, is_train))
+        return self._backward_impl(out_grads, is_train)
+
+    def _backward_impl(self, out_grads=None, is_train=True):
         # out_grads=None (the dominant path) reuses the grads computed by the
         # fused ones-cotangent step — zero extra work. Explicit out_grads
         # re-runs the fused program with the given cotangents: callers

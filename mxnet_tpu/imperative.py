@@ -98,7 +98,18 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     datas = _reconcile_mesh(datas)
     rng = _random.next_key() if schema.needs_rng else None
-    results = fn(rng, *datas) if schema.needs_rng else fn(*datas)
+    from . import profiler, engine
+    if profiler.imperative_enabled():
+        # per-op timing synchronizes the op (engine-profiling role,
+        # threaded_engine.cc:476)
+        results = profiler.profile_op(
+            schema.name,
+            (lambda: fn(rng, *datas)) if schema.needs_rng
+            else (lambda: fn(*datas)))
+    else:
+        results = fn(rng, *datas) if schema.needs_rng else fn(*datas)
+    if engine._sync_mode:
+        jax.block_until_ready(results)   # NaiveEngine determinism toggle
     if not isinstance(results, tuple):
         results = (results,)
 

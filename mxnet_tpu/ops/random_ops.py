@@ -67,17 +67,17 @@ _reg_random("_random_randint",
 
 
 def _neg_binomial(key, r, p, shape):
+    """Gamma-Poisson mixture; scalar or array r/p (broadcast to shape)."""
     k1, k2 = jax.random.split(key)
-    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    r = jnp.broadcast_to(jnp.asarray(r, jnp.float32), shape)
+    lam = jax.random.gamma(k1, r) * (1 - p) / p
     return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
 
 
 def _gen_neg_binomial(key, mu, alpha, shape):
-    k1, k2 = jax.random.split(key)
     r = 1.0 / alpha
     p = r / (r + mu)
-    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
-    return jax.random.poisson(k2, lam, shape).astype(jnp.float32)
+    return _neg_binomial(key, r, p, shape)
 
 
 # sample_* family: distribution params given as arrays; one sample (or `shape`
@@ -158,3 +158,9 @@ def _shuffle(attrs, octx, data):
     return _t(jax.random.permutation(octx.rng, data, axis=0))
 
 register("_shuffle", _shuffle, needs_rng=True, aliases=("shuffle",))
+_reg_sample("_sample_negative_binomial",
+            lambda k, r, p, e: _neg_binomial(k, _bcast(r, e), _bcast(p, e),
+                                             _samp_shape(r, e)), 2)
+_reg_sample("_sample_generalized_negative_binomial",
+            lambda k, mu, al, e: _gen_neg_binomial(
+                k, _bcast(mu, e), _bcast(al, e), _samp_shape(mu, e)), 2)

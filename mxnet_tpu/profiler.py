@@ -1,0 +1,299 @@
+"""Profiler — chrome://tracing JSON emitter + aggregate op stats.
+
+Parity target: src/profiler/profiler.h:87,437 (chrome-trace output,
+aggregate stats) and python/mxnet/profiler.py:28,105 (`set_config`,
+`set_state`, `dump`, `dumps`, pause/resume, Domain/Task/Counter/Marker).
+
+TPU mapping (SURVEY.md §5): two complementary lanes.
+  - The host-side op timeline here: when profiling is on, each imperative
+    op / executor span is timed (blocking on its buffers, the role of the
+    engine's profiling timestamps around ExecuteOprBlock,
+    threaded_engine.cc:476) and emitted as a chrome-trace complete event.
+  - The XLA/XPlane lane: `set_config(xplane_dir=...)` starts a
+    jax.profiler trace on `set_state('run')` for TensorBoard-grade device
+    timelines — the reference has no analog; it replaces nvprof.
+Profiling perturbs async dispatch (ops are synchronized to be timed),
+exactly like the reference's NaiveEngine-style profiling runs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "profiler_set_config", "profiler_set_state", "Domain", "Task",
+           "Counter", "Marker", "Frame"]
+
+_lock = threading.Lock()
+_state = "stop"
+_paused = False
+_events = []            # chrome trace events
+_agg = {}               # name -> [count, total_us, min_us, max_us]
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+    "xplane_dir": None,
+}
+_xplane_active = False
+
+
+def set_config(**kwargs):
+    """mx.profiler.set_config (python/mxnet/profiler.py:28)."""
+    unknown = [k for k in kwargs if k not in _config]
+    if unknown:
+        raise ValueError(f"profiler.set_config: unknown options {unknown}")
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config     # legacy alias (reference keeps both)
+
+
+def is_running():
+    return _state == "run" and not _paused
+
+
+def imperative_enabled():
+    """Gate for the per-imperative-op lane (profile_imperative flag)."""
+    return is_running() and (_config["profile_all"] or
+                             _config["profile_imperative"])
+
+
+def symbolic_enabled():
+    """Gate for executor Forward/Backward spans (profile_symbolic flag)."""
+    return is_running() and (_config["profile_all"] or
+                             _config["profile_symbolic"])
+
+
+def set_state(state="stop", profile_process="worker"):
+    """mx.profiler.set_state: 'run' | 'stop' (profiler.py:105)."""
+    global _state, _xplane_active
+    if state not in ("run", "stop"):
+        raise ValueError("profiler state must be 'run' or 'stop'")
+    prev = _state
+    _state = state
+    if state == "run" and prev != "run" and _config["xplane_dir"]:
+        try:
+            import jax
+            jax.profiler.start_trace(_config["xplane_dir"])
+            _xplane_active = True
+        except Exception:
+            _xplane_active = False
+    if state == "stop" and _xplane_active:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _xplane_active = False
+    if state == "stop" and prev == "run" and _config["continuous_dump"]:
+        dump()
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+
+
+def _record_event(name, cat, ts_us, dur_us, pid=0, tid=None, args=None):
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": ts_us, "dur": dur_us,
+          "pid": pid, "tid": tid if tid is not None else
+          threading.get_ident() % 10000}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+        st = _agg.get(name)
+        if st is None:
+            _agg[name] = [1, dur_us, dur_us, dur_us]
+        else:
+            st[0] += 1
+            st[1] += dur_us
+            st[2] = min(st[2], dur_us)
+            st[3] = max(st[3], dur_us)
+
+
+def record_span(name, cat="operator"):
+    """Context manager timing a span; blocks are the caller's business."""
+    return _Span(name, cat)
+
+
+class _Span:
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = (time.perf_counter() - self.t0) * 1e6
+        _record_event(self.name, self.cat, self.t0 * 1e6, dur)
+        if _config["profile_memory"]:
+            _record_memory_counter()
+        return False
+
+
+def _record_memory_counter():
+    try:
+        import jax
+        live = sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+        with _lock:
+            _events.append({"name": "live_device_bytes", "ph": "C",
+                            "ts": time.perf_counter() * 1e6, "pid": 0,
+                            "args": {"bytes": int(live)}})
+    except Exception:
+        pass
+
+
+def _sync_result(out):
+    import jax
+    if isinstance(out, (list, tuple)):
+        for o in out:
+            _sync_result(o)
+    elif hasattr(out, "wait_to_read"):       # NDArray
+        out.wait_to_read()
+    else:
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+
+
+def profile_op(name, run, results_of=None):
+    """Time `run()` (a thunk returning jax arrays or NDArrays),
+    synchronizing so the span covers device execution — the engine-profiling
+    role."""
+    t0 = time.perf_counter()
+    out = run()
+    _sync_result(out)
+    dur = (time.perf_counter() - t0) * 1e6
+    _record_event(name, "operator", t0 * 1e6, dur)
+    if _config["profile_memory"]:
+        _record_memory_counter()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome-trace JSON (chrome://tracing / perfetto loadable)."""
+    with _lock:
+        trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    path = _config["filename"]
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    if finished:
+        with _lock:
+            _events.clear()
+    return path
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate per-op stats (profiler.h aggregate_stats role)."""
+    with _lock:
+        rows = [(name, st[0], st[1], st[1] / st[0], st[2], st[3])
+                for name, st in sorted(_agg.items(),
+                                       key=lambda kv: -kv[1][1])]
+        if reset:
+            _agg.clear()
+    if format == "json":
+        return json.dumps([{"name": r[0], "count": r[1], "total_us": r[2],
+                            "avg_us": r[3], "min_us": r[4], "max_us": r[5]}
+                           for r in rows])
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+             f"{'Min(us)':>12}{'Max(us)':>12}"]
+    for r in rows:
+        lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>14.1f}{r[3]:>12.1f}"
+                     f"{r[4]:>12.1f}{r[5]:>12.1f}")
+    return "\n".join(lines)
+
+
+# -- user-facing profiling objects (profiler.py Domain/Task/Counter etc.) ---
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            dur = (time.perf_counter() - self._t0) * 1e6
+            _record_event(self.name, f"task:{self.domain.name}",
+                          self._t0 * 1e6, dur)
+            self._t0 = None
+
+    __enter__ = lambda self: (self.start(), self)[1]
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+Frame = Task       # Frame has identical mechanics in the reference
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+        with _lock:
+            _events.append({"name": self.name, "ph": "C",
+                            "ts": time.perf_counter() * 1e6, "pid": 0,
+                            "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _lock:
+            _events.append({"name": self.name, "ph": "i",
+                            "ts": time.perf_counter() * 1e6, "pid": 0,
+                            "s": "p" if scope == "process" else "t"})

@@ -1,0 +1,141 @@
+"""Network visualization — plot_network (graphviz) + print_summary.
+
+Parity target: python/mxnet/visualization.py (SURVEY.md §2.4 misc).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["plot_network", "print_summary"]
+
+
+def _node_label(node):
+    op = node.op.name if node.op is not None else "Variable"
+    label = f"{node.name}\n{op}"
+    for k in ("kernel", "num_filter", "num_hidden", "act_type", "pool_type"):
+        v = node.attrs.get(k)
+        if v is not None:
+            label += f"\n{k}={v}"
+    return label
+
+
+_OP_COLORS = {
+    "Convolution": "#fb8072", "Deconvolution": "#fb8072",
+    "FullyConnected": "#fb8072",
+    "BatchNorm": "#bebada", "LayerNorm": "#bebada",
+    "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+    "Pooling": "#80b1d3",
+    "Concat": "#fdb462", "Flatten": "#fdb462", "Reshape": "#fdb462",
+    "SoftmaxOutput": "#b3de69", "softmax": "#b3de69",
+}
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz.Digraph of the symbol (visualization.py
+    plot_network). Requires the optional `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the python graphviz package") from e
+
+    node_attrs = {"shape": "box", "fixedsize": "false",
+                  **(node_attrs or {})}
+    dot = Digraph(name=title, format=save_format)
+    topo = symbol._topo()
+    nid = {id(n): f"node{i}" for i, n in enumerate(topo)}
+
+    def is_param(n):
+        return n.op is None and (n.name.endswith(("_weight", "_bias",
+                                                  "_gamma", "_beta",
+                                                  "_moving_mean",
+                                                  "_moving_var",
+                                                  "_running_mean",
+                                                  "_running_var")))
+
+    for n in topo:
+        if hide_weights and is_param(n):
+            continue
+        attrs = dict(node_attrs)
+        if n.op is None:
+            attrs.update(style="filled", fillcolor="#8dd3c7")
+        else:
+            attrs.update(style="filled",
+                         fillcolor=_OP_COLORS.get(n.op.name, "#d9d9d9"))
+        dot.node(nid[id(n)], label=_node_label(n), **attrs)
+    for n in topo:
+        if hide_weights and is_param(n):
+            continue
+        for (src, _) in n.inputs:
+            if hide_weights and is_param(src):
+                continue
+            dot.edge(nid[id(src)], nid[id(n)])
+    return dot
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-table summary with output shapes + parameter counts
+    (visualization.py print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    shape_map = {}
+    if shape is not None:
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        args, aux = symbol._input_vars()
+        for n, s in zip(args, arg_shapes):
+            shape_map[n.name] = s
+        for n, s in zip(aux, aux_shapes):
+            shape_map[n.name] = s
+
+    def out_shape_of(node):
+        if shape is None:
+            return ""
+        try:
+            sub = __import__("mxnet_tpu").symbol.Symbol([(node, 0)])
+            _, outs, _ = sub.infer_shape_partial(**shape)
+            return str(outs[0]) if outs and outs[0] else ""
+        except MXNetError:
+            return ""
+
+    def prod(s):
+        p = 1
+        for d in s:
+            p *= d
+        return p
+
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    lines = ["_" * line_length]
+    row = ""
+    for f, p in zip(fields, positions):
+        row = (row + f).ljust(p)
+    lines.append(row)
+    lines.append("=" * line_length)
+
+    total = 0
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        params = 0
+        for (src, _) in node.inputs:
+            if src.op is None and src.name in shape_map and \
+                    not src.name.startswith("data") and \
+                    src.name not in ("data", "softmax_label", "label"):
+                params += prod(shape_map[src.name])
+        total += params
+        prev = ",".join(s.name for (s, _) in node.inputs if s.op is not None)
+        if not prev:
+            prev = ",".join(s.name for (s, _) in node.inputs)
+        cols = [f"{node.name} ({node.op.name})", out_shape_of(node),
+                str(params), prev]
+        row = ""
+        for c, p in zip(cols, positions):
+            row = (row + c).ljust(p)
+        lines.append(row)
+        lines.append("_" * line_length)
+    lines.append(f"Total params: {total}")
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out

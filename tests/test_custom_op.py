@@ -1,0 +1,143 @@
+"""Custom op escape hatch tests.
+
+Reference pattern: tests/python/unittest/test_operator.py test_custom_op —
+a python op must run imperatively, under autograd, inside a Symbol graph,
+and inside a hybridized Gluon net.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+@mx.operator.register("scaled_square")
+class ScaledSquareProp(mx.operator.CustomOpProp):
+    def __init__(self, scale=1.0):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ScaledSquare(self.scale)
+
+
+class ScaledSquare(mx.operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], self.scale * in_data[0] ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2.0 * self.scale * in_data[0] * out_grad[0])
+
+
+def test_custom_imperative_and_grad():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = mx.nd.Custom(x, scale=3.0, op_type="scaled_square")
+    np.testing.assert_allclose(y.asnumpy(), 3.0 * x.asnumpy() ** 2)
+    x.attach_grad()
+    with autograd.record():
+        z = mx.nd.Custom(x, scale=2.0, op_type="scaled_square")
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4.0 * x.asnumpy())
+
+
+def test_custom_symbolic_train():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Custom(net, scale=1.5, op_type="scaled_square")
+    net = mx.sym.sum(net)
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.normal(size=(2, 3))
+    ex.arg_dict["fc_weight"][:] = rng.normal(size=(4, 3)) * 0.3
+    ex.arg_dict["fc_bias"][:] = 0
+    ex.forward(is_train=True)
+    ex.backward()
+    # numeric check of d(sum(1.5*fc^2))/d(weight)
+    g = ex.grad_dict["fc_weight"].asnumpy()
+    eps, idx = 1e-3, (1, 2)
+    w = ex.arg_dict["fc_weight"].asnumpy().copy()
+    outs = []
+    for delta in (eps, -eps):
+        w2 = w.copy()
+        w2[idx] += delta
+        ex.arg_dict["fc_weight"][:] = w2
+        outs.append(float(ex.forward(is_train=False)[0].asnumpy()))
+    np.testing.assert_allclose(g[idx], (outs[0] - outs[1]) / (2 * eps),
+                               rtol=2e-2, atol=1e-3)
+
+
+@mx.operator.register("twin_outputs")
+class TwinProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["plus", "minus"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Twin()
+
+
+class Twin(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + 1.0)
+        self.assign(out_data[1], req[1], in_data[0] - 1.0)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] + out_grad[1])
+
+
+def test_custom_multi_output():
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    a, b = mx.nd.Custom(x, op_type="twin_outputs")
+    np.testing.assert_allclose(a.asnumpy(), 2.0)
+    np.testing.assert_allclose(b.asnumpy(), 0.0)
+
+
+def test_custom_in_hybridized_gluon():
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            return F.Custom(h, scale=2.0, op_type="scaled_square")
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(1).normal(size=(3, 5)))
+    with autograd.record():
+        out = net(x)
+        loss = mx.nd.sum(out)
+    loss.backward()
+    w = net.fc.weight
+    assert w.grad().asnumpy().shape == (4, 5)
+    assert np.abs(w.grad().asnumpy()).sum() > 0
+    # trains: loss decreases under sgd steps
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.005})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            loss = mx.nd.sum(net(x))
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]

@@ -425,3 +425,22 @@ def test_check_numeric_gradient_catches_wrong_grad():
     with pytest.raises(AssertionError):
         check_numeric_gradient(sym, {"data": _any(S)}, rtol=5e-2,
                                atol=1e-3)
+
+
+def test_negative_binomial_moments():
+    """Distribution-moment checks (reference test_random.py pattern)."""
+    mx.random.seed(7)
+    n = 40000
+    x = mx.nd.random.negative_binomial(k=5, p=0.4, shape=(n,)).asnumpy()
+    np.testing.assert_allclose(x.mean(), 5 * 0.6 / 0.4, rtol=0.05)
+    np.testing.assert_allclose(x.var(), 5 * 0.6 / 0.4 ** 2, rtol=0.1)
+    y = mx.nd.random.generalized_negative_binomial(
+        mu=2.0, alpha=0.3, shape=(n,)).asnumpy()
+    np.testing.assert_allclose(y.mean(), 2.0, rtol=0.05)
+    np.testing.assert_allclose(y.var(), 2.0 + 0.3 * 4.0, rtol=0.1)
+    # array-parameter variants
+    z = mx.nd._sample_generalized_negative_binomial(
+        mx.nd.array([2.0, 4.0]), mx.nd.array([0.3, 0.2]),
+        shape=(n,)).asnumpy()
+    assert z.shape == (2, n)
+    np.testing.assert_allclose(z.mean(1), [2.0, 4.0], rtol=0.05)
