@@ -11,8 +11,9 @@ no-ops because XLA/PJRT owns the concern:
 
   MXNET_ENGINE_TYPE            -> engine.set_engine_type (NaiveEngine = sync)
   MXNET_PROFILER_AUTOSTART     -> profiler.set_state('run') at import
-  MXNET_KVSTORE_BIGARRAY_BOUND -> kvstore key-sharding threshold
   MXNET_EXEC_BULK_EXEC_*       -> engine.set_bulk_size hint (XLA fuses anyway)
+  MXNET_KVSTORE_BIGARRAY_BOUND -> recorded only: keys are never sharded
+                                  across servers here (no ps-lite analog)
   MXNET_ENFORCE_DETERMINISM    -> jax default; recorded
   MXNET_CPU_WORKER_NTHREADS /
   MXNET_GPU_WORKER_NTHREADS    -> XLA owns threading; recorded

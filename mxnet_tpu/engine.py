@@ -10,7 +10,6 @@ reference scripts run; real batching is done by jit fusion.
 from __future__ import annotations
 
 import contextlib
-import os
 
 _bulk_size = 0
 

@@ -25,9 +25,16 @@ from .ops.registry import OpCtx
 __all__ = ["Executor"]
 
 
-def _build_runner(symbol, is_train):
+def _build_runner(symbol, is_train, group2dev=None):
     """Emit run(arg_values: tuple, aux_values: tuple, rng) ->
-    (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller."""
+    (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller.
+
+    `group2dev` maps `ctx_group` attr names (mx.AttrScope(ctx_group=...))
+    to jax devices: a node tagged with a mapped group gets its outputs
+    committed to that device inside the compiled program — the role of the
+    reference's PlaceDevice pass inserting _CrossDeviceCopy nodes
+    (graph_executor.cc:314,407); XLA emits the transfers.
+    """
     topo = symbol._topo()
     args_n, aux_n = symbol._input_vars()
     arg_index = {id(n): i for i, n in enumerate(args_n)}
@@ -59,6 +66,11 @@ def _build_runner(symbol, is_train):
             res = node.op.fcompute(parsed, octx, *ins)
             if not isinstance(res, tuple):
                 res = (res,)
+            if group2dev:
+                grp = node.user_attrs.get("ctx_group")
+                dev = group2dev.get(grp) if grp else None
+                if dev is not None:
+                    res = tuple(jax.device_put(r, dev) for r in res)
             n_out = node.num_outputs()
             vals[pos] = res[:n_out]
             if node.op.mutates_aux and (is_train or node.op.aux_always):
@@ -74,10 +86,20 @@ def _build_runner(symbol, is_train):
 
 class Executor:
     def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req_dict,
-                 aux_dict, mesh=None, sharded_args=()):
+                 aux_dict, mesh=None, sharded_args=(), group2ctx=None):
         from .ndarray.ndarray import NDArray
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # model-parallel ctx groups (simple_bind(group2ctx=...)): outputs of
+        # tagged nodes are committed to their group's device in-program
+        self._group2dev = None
+        if group2ctx:
+            if mesh is not None:
+                raise MXNetError(
+                    "group2ctx model parallelism cannot be combined with a "
+                    "data-parallel mesh executor")
+            self._group2dev = {g: c.jax_device()
+                               for g, c in group2ctx.items()}
         # Multi-device data parallelism: ONE program sharded over `mesh`
         # (role of DataParallelExecutorGroup's per-device executor replicas,
         # executor_group.py:129). `sharded_args` (data/label names) are
@@ -115,7 +137,7 @@ class Executor:
     # -- construction helpers ----------------------------------------------
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
-                     mesh=None, sharded_args=()):
+                     mesh=None, sharded_args=(), group2ctx=None):
         from .ndarray import ndarray as ndmod
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
         arg_names = symbol.list_arguments()
@@ -137,10 +159,12 @@ class Executor:
         aux_dict = {n: ndmod.zeros(s, ctx=ctx)
                     for n, s in zip(aux_names, aux_shapes)}
         return Executor(symbol, ctx, arg_dict, grad_dict, req_dict, aux_dict,
-                        mesh=mesh, sharded_args=sharded_args)
+                        mesh=mesh, sharded_args=sharded_args,
+                        group2ctx=group2ctx)
 
     @staticmethod
-    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
+              group2ctx=None):
         from .ndarray.ndarray import NDArray
         from .ndarray import ndarray as ndmod
         arg_names = symbol.list_arguments()
@@ -178,7 +202,8 @@ class Executor:
             aux_dict = dict(zip(aux_names, aux_states))
         else:
             aux_dict = dict(aux_states)
-        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                        group2ctx=group2ctx)
 
     # -- execution ----------------------------------------------------------
     def _arg_sharding(self, name):
@@ -265,7 +290,8 @@ class Executor:
             outputs, new_aux = self._forward_train(rng)
         else:
             if self._jit_eval is None:
-                run_eval = _build_runner(self._symbol, False)
+                run_eval = _build_runner(self._symbol, False,
+                                         group2dev=self._group2dev)
                 self._jit_eval = jax.jit(run_eval)
             outputs, new_aux = self._jit_eval(
                 self._arg_values(), self._aux_values(), rng)
@@ -279,7 +305,8 @@ class Executor:
         """One fused fwd+bwd XLA executable per executor (jax re-keys on
         shapes). Built once: the round-1 design re-ran jax.vjp per batch,
         re-tracing the whole graph every step (VERDICT weak #3)."""
-        run = _build_runner(self._symbol, True)
+        run = _build_runner(self._symbol, True,
+                            group2dev=self._group2dev)
         n_args = len(self._arg_names)
         diff_pos = [i for i, n in enumerate(self._arg_names)
                     if self._grad_req.get(n, "null") != "null"]

@@ -24,6 +24,8 @@ from __future__ import annotations
 import logging
 import pickle
 
+import numpy as _np
+
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, zeros
 from . import optimizer as opt
@@ -117,21 +119,21 @@ class KVStore:
                 self._store[k] = merged.as_in_context(stored.context)
 
     def _compress(self, k, merged):
-        """2-bit stochastic-threshold quantization with error-feedback
-        residual (reference quantize_2bit/dequantize_2bit,
-        src/kvstore/gradient_compression-inl.h:40,97): each element becomes
-        {-threshold, 0, +threshold}; the quantization error accumulates in a
-        residual folded into the next push."""
-        from .ndarray.ndarray import zeros_like
+        """Packed 2-bit quantization with error-feedback residual
+        (reference quantize_2bit/dequantize_2bit,
+        src/kvstore/gradient_compression-inl.h:40,97): 16 values per 32-bit
+        word, codes 11=+threshold / 10=-threshold / 00=zero; quantization
+        error carries in the residual. The push pipeline round-trips
+        through the packed words exactly like the reference wire format."""
+        from .ndarray.ndarray import array as nd_array
         threshold = float(self._compression.get("threshold", 0.5))
+        vals = merged.asnumpy()
         if k not in self._residuals:
-            self._residuals[k] = zeros_like(merged)
-        residual = self._residuals[k]
-        residual += merged
-        quantized = ((residual >= threshold) - (residual <= -threshold)) \
-            * threshold
-        residual -= quantized
-        return quantized
+            self._residuals[k] = _np.zeros(vals.shape, _np.float32)
+        packed, self._residuals[k] = quantize_2bit(
+            vals, self._residuals[k], threshold)
+        decomp = dequantize_2bit(packed, vals.size, threshold)
+        return nd_array(decomp.reshape(vals.shape), ctx=merged.context)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None, "pull requires out="
@@ -144,8 +146,42 @@ class KVStore:
                 stored.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull emulated densely (TPU-honest: row_sparse is dense)."""
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows (kvstore_dist.h:260 row_sparse
+        path). Dense-backed: rows outside row_ids come back zero."""
+        assert out is not None, "row_sparse_pull requires out="
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        from .ndarray.ndarray import array as nd_array
+        keys, olists = self._key_list(key, out)
+        single_key = not isinstance(key, (list, tuple))
+        if single_key:
+            # row_ids aligns with the outputs of the single key
+            rlists = [row_ids if isinstance(row_ids, (list, tuple))
+                      else [row_ids]]
+        else:
+            rlists = list(row_ids) if isinstance(row_ids, (list, tuple)) \
+                else [row_ids]
+            if len(rlists) != len(keys):
+                raise MXNetError(
+                    f"row_sparse_pull: {len(keys)} keys but "
+                    f"{len(rlists)} row_ids entries")
+            rlists = [r if isinstance(r, (list, tuple)) else [r]
+                      for r in rlists]
+        for k, olist, rid_list in zip(keys, olists, rlists):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            stored = self._store[k].asnumpy()
+            if len(rid_list) == 1 and len(olist) > 1:
+                rid_list = list(rid_list) * len(olist)
+            if len(rid_list) != len(olist):
+                raise MXNetError(
+                    f"row_sparse_pull: key {k!r} has {len(olist)} outputs "
+                    f"but {len(rid_list)} row_ids")
+            for o, rid in zip(olist, rid_list):
+                ids = rid.asnumpy().astype(_np.int64).ravel()
+                masked = _np.zeros_like(stored)
+                masked[ids] = stored[ids]
+                nd_array(masked, ctx=o.context).copyto(o)
 
     # -- optimizer ----------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -197,3 +233,35 @@ def create(name="local"):
     if name not in known:
         raise MXNetError(f"unknown kvstore type {name!r}")
     return KVStore(name)
+
+
+# -- packed 2-bit gradient compression wire format --------------------------
+# (gradient_compression-inl.h:40-120): element j of a 16-element block sits
+# in bits (31-2*(j%16), 30-2*(j%16)) of word j//16; 11 = +threshold,
+# 10 = -threshold, 00 = below threshold.
+
+def quantize_2bit(arr, residual, threshold):
+    """Returns (packed float32 words, new_residual). Vectorized numpy."""
+    flat = arr.astype(_np.float32).ravel() + residual.ravel()
+    pos = flat >= threshold
+    neg = flat <= -threshold
+    codes = _np.where(pos, 3, _np.where(neg, 2, 0)).astype(_np.uint32)
+    new_res = flat - threshold * pos + threshold * neg
+    n = flat.size
+    nw = (n + 15) // 16
+    padded = _np.zeros(nw * 16, _np.uint32)
+    padded[:n] = codes
+    shifts = (30 - 2 * _np.arange(16)).astype(_np.uint32)
+    words = (padded.reshape(nw, 16) << shifts).sum(axis=1, dtype=_np.uint64)
+    words = words.astype(_np.uint32)
+    return words.view(_np.float32), new_res.reshape(residual.shape)
+
+
+def dequantize_2bit(packed, orig_size, threshold):
+    """Inverse of quantize_2bit: packed float32 words -> float32 values."""
+    words = _np.ascontiguousarray(packed).view(_np.uint32)
+    shifts = (30 - 2 * _np.arange(16)).astype(_np.uint32)
+    codes = ((words[:, None] >> shifts) & 3).ravel()[:orig_size]
+    return _np.where(codes == 3, threshold,
+                     _np.where(codes == 2, -threshold, 0.0)
+                     ).astype(_np.float32)

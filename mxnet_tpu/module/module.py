@@ -39,6 +39,11 @@ class Module(BaseModule):
             context = [context]
         self._context = context
         self._work_load_list = work_load_list
+        # group2ctxs: ctx_group -> Context (or per-replica list; the
+        # single-program executor uses one mapping). See Executor group2ctx.
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctxs = group2ctxs
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -281,7 +286,8 @@ class Module(BaseModule):
                         f"be divisible by the number of contexts ({n})")
         self._exec = self._symbol.simple_bind(
             ctx=ctx, grad_req=reqs, type_dict=type_kwargs, mesh=mesh,
-            sharded_args=sharded, **shape_kwargs)
+            sharded_args=sharded, group2ctx=self._group2ctxs,
+            **shape_kwargs)
         self.binded = True
 
         # already-initialized params (Module.load / rebind) must reach the

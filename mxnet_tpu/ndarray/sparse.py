@@ -28,22 +28,19 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        import numpy as np
         a = self.asnumpy()
-        idx = [np.nonzero(row)[0] for row in a]
-        return array(np.concatenate(idx) if idx else np.array([]),
-                     dtype="int64")
+        # vectorized: np.nonzero walks row-major, exactly CSR order
+        return array(_np.nonzero(a)[1], dtype="int64")
 
     @property
     def indptr(self):
-        import numpy as np
         a = self.asnumpy()
         counts = (a != 0).sum(axis=1)
-        return array(np.concatenate([[0], np.cumsum(counts)]), dtype="int64")
+        return array(_np.concatenate([[0], _np.cumsum(counts)]),
+                     dtype="int64")
 
     @property
     def data(self):
-        import numpy as np
         a = self.asnumpy()
         return array(a[a != 0])
 
@@ -64,14 +61,12 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
-        import numpy as np
         a = self.asnumpy().reshape(self.shape[0], -1)
-        nz = np.nonzero((a != 0).any(axis=1))[0]
+        nz = _np.nonzero((a != 0).any(axis=1))[0]
         return array(nz, dtype="int64")
 
     @property
     def data(self):
-        import numpy as np
         a = self.asnumpy()
         nz = _np.nonzero((a.reshape(a.shape[0], -1) != 0).any(axis=1))[0]
         return array(a[nz])
@@ -94,9 +89,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         indptr = _np.asarray(getattr(indptr, "asnumpy", lambda: indptr)(),
                              dtype=_np.int64)
         dense = _np.zeros(shape, dtype=data.dtype if dtype is None else dtype)
-        for r in range(shape[0]):
-            for j in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[j]] = data[j]
+        rows = _np.repeat(_np.arange(shape[0]), _np.diff(indptr))
+        dense[rows, indices] = data
         nd = array(dense, ctx=ctx, dtype=dtype)
     else:
         nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,

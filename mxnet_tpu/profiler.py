@@ -128,28 +128,6 @@ def _record_event(name, cat, ts_us, dur_us, pid=0, tid=None, args=None):
             st[3] = max(st[3], dur_us)
 
 
-def record_span(name, cat="operator"):
-    """Context manager timing a span; blocks are the caller's business."""
-    return _Span(name, cat)
-
-
-class _Span:
-    def __init__(self, name, cat):
-        self.name = name
-        self.cat = cat
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        dur = (time.perf_counter() - self.t0) * 1e6
-        _record_event(self.name, self.cat, self.t0 * 1e6, dur)
-        if _config["profile_memory"]:
-            _record_memory_counter()
-        return False
-
-
 def _record_memory_counter():
     try:
         import jax
@@ -176,7 +154,7 @@ def _sync_result(out):
             pass
 
 
-def profile_op(name, run, results_of=None):
+def profile_op(name, run):
     """Time `run()` (a thunk returning jax arrays or NDArrays),
     synchronizing so the span covers device execution — the engine-profiling
     role."""

@@ -402,12 +402,14 @@ class Symbol:
                     sharded_args=(), **kwargs):
         from ..executor import Executor
         return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
-                                     mesh=mesh, sharded_args=sharded_args)
+                                     mesh=mesh, sharded_args=sharded_args,
+                                     group2ctx=group2ctx)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
-        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states,
+                              group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx, kwargs)

@@ -1,0 +1,264 @@
+"""SequentialModule / PythonModule / group2ctx / sparse / compression tests.
+
+Reference patterns: tests/python/unittest/test_module.py (test_module_layout,
+sequential), tests/nightly/test_kvstore.py (compute_expected_2bit_quantization).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+
+
+# ---------------------------------------------------------------------------
+# SequentialModule
+# ---------------------------------------------------------------------------
+
+def _feature_sym():
+    data = mx.sym.Variable("data")
+    return mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=16,
+                                                   name="feat_fc"),
+                             act_type="relu", name="feat_act")
+
+
+def _head_sym():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="head_fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_sequential_module_trains():
+    rng = np.random.RandomState(0)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_feature_sym(), label_names=None))
+    seq.add(mx.mod.Module(_head_sym()), take_labels=True)
+    seq.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    x = rng.normal(size=(8, 10)).astype(np.float32)
+    w = rng.normal(size=(3, 10)).astype(np.float32)
+    y = (x @ w.T).argmax(1).astype(np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    metric = mx.metric.Accuracy()
+    accs = []
+    for _ in range(30):
+        seq.forward(batch, is_train=True)
+        seq.backward()
+        seq.update()
+        metric.reset()
+        seq.update_metric(metric, [mx.nd.array(y)])
+        accs.append(metric.get()[1])
+    assert accs[-1] >= 0.8, accs[-5:]
+    out = seq.get_outputs()[0]
+    assert out.shape == (8, 3)
+    arg, _ = seq.get_params()
+    assert "feat_fc_weight" in arg and "head_fc_weight" in arg
+
+
+def test_sequential_module_fit():
+    rng = np.random.RandomState(1)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(_feature_sym(), label_names=None))
+    seq.add(mx.mod.Module(_head_sym()), take_labels=True)
+    seq.fit(it, num_epoch=4,
+            optimizer_params={"learning_rate": 0.2})
+    score = seq.score(it, mx.metric.Accuracy())
+    assert score[0][1] > 0.6
+
+
+# ---------------------------------------------------------------------------
+# PythonModule
+# ---------------------------------------------------------------------------
+
+def test_python_loss_module():
+    """Feature module + python loss head chained sequentially."""
+
+    def nll_grad(scores, labels):
+        s = scores.asnumpy()
+        p = np.exp(s - s.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        lab = labels.asnumpy().astype(int)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return p
+
+    rng = np.random.RandomState(2)
+    seq = mx.mod.SequentialModule()
+    head = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                 name="fc")
+    seq.add(mx.mod.Module(head, label_names=None))
+    seq.add(mx.mod.PythonLossModule(grad_func=nll_grad), take_labels=True)
+    seq.bind(data_shapes=[("data", (8, 5))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    w = rng.normal(size=(3, 5)).astype(np.float32)
+    y = (x @ w.T).argmax(1).astype(np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    correct = []
+    for _ in range(40):
+        seq.forward(batch, is_train=True)
+        seq.backward()
+        seq.update()
+        pred = seq.get_outputs()[0].asnumpy().argmax(1)
+        correct.append((pred == y).mean())
+    assert correct[-1] >= 0.8, correct[-5:]
+
+
+# ---------------------------------------------------------------------------
+# group2ctx model parallelism
+# ---------------------------------------------------------------------------
+
+def test_group2ctx_executes():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        h = mx.sym.FullyConnected(a, num_hidden=8, name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    ex = out.simple_bind(mx.cpu(0), a=(2, 6),
+                         group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    rng = np.random.RandomState(3)
+    for k in ex.arg_dict:
+        ex.arg_dict[k][:] = rng.normal(size=ex.arg_dict[k].shape)
+    res = ex.forward(is_train=True)[0]
+    # numerics identical to the unplaced graph
+    ref = out.simple_bind(mx.cpu(0), a=(2, 6))
+    for k in ref.arg_dict:
+        ref.arg_dict[k][:] = ex.arg_dict[k].asnumpy()
+    want = ref.forward()[0].asnumpy()
+    np.testing.assert_allclose(res.asnumpy(), want, rtol=1e-5)
+    ex.backward()
+    assert ex.grad_dict["fc1_weight"].asnumpy().shape == (8, 6)
+
+
+def test_group2ctx_mesh_conflict():
+    import pytest
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2)
+    from mxnet_tpu.parallel.mesh import mesh_for_contexts
+    mesh = mesh_for_contexts([mx.cpu(i) for i in range(2)])
+    with pytest.raises(mx.MXNetError):
+        sym.simple_bind(mx.cpu(), data=(4, 3), mesh=mesh,
+                        sharded_args=("data",),
+                        group2ctx={"g": mx.cpu(1)})
+
+
+# ---------------------------------------------------------------------------
+# 2-bit compression wire format (reference test_kvstore numerics)
+# ---------------------------------------------------------------------------
+
+def expected_2bit(arr, residual, threshold):
+    """Reimplementation of the reference's
+    compute_expected_2bit_quantization (tests/nightly/test_kvstore.py:33)."""
+    import struct
+    bits = ""
+    new_residual = np.zeros_like(arr)
+    decompr = np.zeros_like(arr)
+    flat = arr.ravel()
+    res = residual.ravel()
+    nres = new_residual.ravel()
+    dec = decompr.ravel()
+    for i in range(flat.size):
+        a = flat[i] + res[i]
+        if a >= threshold:
+            bits += "11"
+            nres[i] = a - threshold
+            dec[i] = threshold
+        elif a <= -threshold:
+            bits += "10"
+            nres[i] = a + threshold
+            dec[i] = -threshold
+        else:
+            bits += "00"
+            nres[i] = a
+            dec[i] = 0.0
+    bits += "0" * (-len(bits) % 32)
+    words = []
+    for w in range(len(bits) // 32):
+        s = bits[w * 32:(w + 1) * 32]
+        words.append(struct.unpack("f", struct.pack("I", int(s, 2)))[0])
+    return np.array(words, np.float32), new_residual, decompr
+
+
+def test_2bit_compression_matches_reference_numerics():
+    rng = np.random.RandomState(4)
+    arr = rng.normal(0, 1, (3, 11)).astype(np.float32)
+    residual = rng.normal(0, 0.2, (3, 11)).astype(np.float32)
+    threshold = 0.5
+    packed, new_res = kvs.quantize_2bit(arr, residual.copy(), threshold)
+    want_words, want_res, want_dec = expected_2bit(arr, residual, threshold)
+    np.testing.assert_array_equal(packed.view(np.uint32),
+                                  want_words.view(np.uint32))
+    np.testing.assert_allclose(new_res, want_res, rtol=1e-6)
+    dec = kvs.dequantize_2bit(packed, arr.size, threshold)
+    np.testing.assert_allclose(dec, want_dec.ravel(), rtol=1e-6)
+
+
+def test_kvstore_compression_error_feedback():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    # push 0.3 twice: first push under threshold -> no update; residual 0.6
+    # exceeds threshold on the second push
+    kv.push("w", mx.nd.array([0.3, 0.3, 0.3, 0.3]))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    # below threshold: dequantized push is zero, residual holds 0.3
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+    kv.push("w", mx.nd.array([0.3, 0.3, 0.3, 0.3]))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)  # residual crossed 0.5
+    # with an updater the dequantized grad applies
+    kv2 = mx.kv.create("local")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("w", mx.nd.zeros((2,)))
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv2.push("w", mx.nd.array([0.3, -0.3]))
+    kv2.pull("w", out=(o := mx.nd.zeros((2,))))
+    np.testing.assert_allclose(o.asnumpy(), 0.0)    # below threshold
+    kv2.push("w", mx.nd.array([0.3, -0.3]))
+    kv2.pull("w", out=o)
+    np.testing.assert_allclose(o.asnumpy(), [-0.5, 0.5], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse accessors + row_sparse_pull
+# ---------------------------------------------------------------------------
+
+def test_csr_accessors_vectorized():
+    from mxnet_tpu.ndarray import sparse
+    dense = np.array([[0, 2, 0], [3, 0, 4], [0, 0, 0]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+    np.testing.assert_array_equal(csr.data.asnumpy(), [2, 3, 4])
+    # construction from (data, indices, indptr)
+    back = sparse.csr_matrix((csr.data, csr.indices, csr.indptr),
+                             shape=(3, 3))
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+
+
+def test_row_sparse_pull_row_ids():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 3]))
+    want = np.zeros_like(w)
+    want[[1, 3]] = w[[1, 3]]
+    np.testing.assert_array_equal(out.asnumpy(), want)
+
+
+def test_row_sparse_pull_multi_out_row_ids():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("emb", mx.nd.array(w))
+    o1, o2 = mx.nd.zeros((4, 3)), mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=[o1, o2],
+                       row_ids=[mx.nd.array([0]), mx.nd.array([2])])
+    assert o1.asnumpy()[0].sum() == w[0].sum() and o1.asnumpy()[2].sum() == 0
+    assert o2.asnumpy()[2].sum() == w[2].sum() and o2.asnumpy()[0].sum() == 0
