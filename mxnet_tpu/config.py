@@ -86,3 +86,10 @@ def _apply_startup():
     if get("MXNET_PROFILER_AUTOSTART"):
         from . import profiler
         profiler.set_state("run")
+    # Join the distributed job NOW if launched by tools/launch.py:
+    # jax.distributed.initialize must run before any XLA backend use, and
+    # user scripts create arrays long before they reach
+    # kvstore.create('dist_*').
+    if int(os.environ.get("DMLC_NUM_WORKER", "1")) > 1:
+        from . import dist
+        dist.init_process_group()

@@ -42,17 +42,22 @@ class Context:
         return _devtype2mask[self.device_type]
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device (lazy; import-time safe)."""
+        """Resolve to a concrete jax.Device (lazy; import-time safe).
+
+        Uses *process-local* devices: under jax.distributed, jax.devices()
+        is the global list and another process's device is non-addressable
+        — committing arrays there wedges host collectives.
+        """
         import jax
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:
             # 'tpu' and the 'gpu' compat alias both mean "the accelerator":
             # whatever platform jax's default backend exposes.
-            devs = jax.devices()
+            devs = jax.local_devices()
             if devs and devs[0].platform == "cpu":
                 # host-only environment (tests): accelerator alias -> cpu devices
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
         if self.device_id >= len(devs):
             raise ValueError(
                 f"device_id {self.device_id} out of range for {self.device_type} "

@@ -1,0 +1,111 @@
+"""Multi-host distributed runtime — the ps-lite replacement.
+
+Parity target: src/kvstore/kvstore_dist{,_server}.h + tools/launch.py
+(SURVEY.md §2.3). The reference ships gradients to ZMQ parameter servers;
+TPU-natively there are no servers: every process joins one jax.distributed
+job (GRPC coordination service), gradients are summed with device
+collectives (Gloo on CPU hosts, ICI/DCN on TPU pods), and the optimizer
+runs identically in every process — the "server-side update" degenerates to
+a replicated deterministic update, which is exactly sync parameter-server
+semantics.
+
+Environment contract (the reference's dmlc-tracker vars, so launch scripts
+port unchanged):
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> coordinator address
+  DMLC_NUM_WORKER                      -> num_processes
+  DMLC_WORKER_ID                       -> process_id
+jax-native MXNET_COORDINATOR ("host:port") is also accepted.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+_initialized = False
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_process_group(coordinator_address=None, num_processes=None,
+                       process_id=None):
+    """Join the distributed job (idempotent). Reads the DMLC_* env contract
+    when args are omitted; no-ops for single-process jobs."""
+    global _initialized
+    if _initialized:
+        return True
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXNET_COORDINATOR")
+        if coordinator_address is None:
+            uri = os.environ.get("DMLC_PS_ROOT_URI")
+            port = os.environ.get("DMLC_PS_ROOT_PORT")
+            if uri and port:
+                coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    if num_processes <= 1:
+        return False
+    if coordinator_address is None:
+        raise MXNetError(
+            "distributed kvstore needs a coordinator: set DMLC_PS_ROOT_URI/"
+            "DMLC_PS_ROOT_PORT (launch via tools/launch.py) or "
+            "MXNET_COORDINATOR=host:port")
+    import jax
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        raise MXNetError(
+            "jax.distributed must initialize before any jax computation — "
+            "import mxnet_tpu with the DMLC_* env set (tools/launch.py does "
+            "this) instead of creating the dist kvstore late: " + str(e)
+        ) from e
+    _initialized = True
+    return True
+
+
+def allreduce_sum(values):
+    """Sum a host-local numpy/jax array across all processes.
+
+    CPU hosts ride Gloo; TPU pods ride ICI/DCN — jax picks the transport.
+    This is the explicit-push path only; sharded training steps get their
+    cross-process psum fused into the compiled program instead.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+    gathered = _local_value(multihost_utils.process_allgather(values))
+    return gathered.sum(axis=0)
+
+
+def _local_value(x):
+    """Pull the host-local replica out of a (fully replicated) global
+    jax.Array; numpy passes through."""
+    import numpy as np
+    if hasattr(x, "addressable_shards"):
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
+def broadcast_from_root(values):
+    """Every process receives process 0's value (kvstore init broadcast,
+    kvstore_dist.h init path)."""
+    import jax
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+    return _local_value(multihost_utils.broadcast_one_to_all(values))
+
+
+def barrier(name="kvstore"):
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

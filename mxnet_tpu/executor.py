@@ -292,7 +292,10 @@ class Executor:
             if self._jit_eval is None:
                 run_eval = _build_runner(self._symbol, False,
                                          group2dev=self._group2dev)
-                self._jit_eval = jax.jit(run_eval)
+                # group2ctx: eager segmented execution (in-jit device_put
+                # is a no-op; see _build_train_fns)
+                self._jit_eval = run_eval if self._group2dev \
+                    else jax.jit(run_eval)
             outputs, new_aux = self._jit_eval(
                 self._arg_values(), self._aux_values(), rng)
             self._pending = self._pending_grads = None
@@ -339,10 +342,22 @@ class Executor:
                                 for a in new_aux)
             return outputs, new_aux, dgrads
 
-        self._fused_ones = jax.jit(
-            lambda d, o, a, r: fwd_bwd(d, o, a, r, None))
-        self._fused_ct = jax.jit(fwd_bwd)
-        self._jit_fwd_train = jax.jit(merged)
+        if self._group2dev:
+            # model-parallel executors run EAGERLY segmented: whole-graph
+            # jit ignores in-program device_put (XLA pins one device per
+            # program), so cross-device placement must happen between
+            # per-op dispatches — the true analog of the reference's
+            # per-device executor segments joined by _CrossDeviceCopy.
+            # Cost: op-by-op dispatch + per-step vjp retrace, paid only
+            # when group2ctx is requested.
+            self._fused_ones = lambda d, o, a, r: fwd_bwd(d, o, a, r, None)
+            self._fused_ct = fwd_bwd
+            self._jit_fwd_train = merged
+        else:
+            self._fused_ones = jax.jit(
+                lambda d, o, a, r: fwd_bwd(d, o, a, r, None))
+            self._fused_ct = jax.jit(fwd_bwd)
+            self._jit_fwd_train = jax.jit(merged)
 
     def _split_argv(self, argv):
         diff_set = set(self._diff_pos)

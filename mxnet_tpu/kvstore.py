@@ -48,10 +48,16 @@ class KVStore:
         self._compression = None
         self._residuals = {}    # error-feedback state per key (2bit mode)
         self._str_key_int = {}  # str key -> stable int for updater indices
+        self._dist = False
         if "async" in kind:
             logging.warning(
                 "kvstore %r: async parameter-server mode has no TPU/ICI "
                 "analog; running synchronously (SURVEY.md §2.3)", kind)
+        if "dist" in kind:
+            # join the job (jax.distributed; the ps-lite/tracker role).
+            # Single-process env (no DMLC_* vars) degrades to local.
+            from . import dist
+            self._dist = dist.init_process_group() or dist.is_initialized()
 
     # -- identity -----------------------------------------------------------
     @property
@@ -90,7 +96,15 @@ class KVStore:
                 raise MXNetError(f"key {k!r} already initialized")
             v = vlist[0]
             self._str_key_int.setdefault(k, len(self._str_key_int))
-            self._store[k] = v.copy()
+            if self._dist:
+                # all workers receive rank 0's initial value
+                # (kvstore_dist.h init semantics)
+                from . import dist
+                from .ndarray.ndarray import array as nd_array
+                synced = dist.broadcast_from_root(v.asnumpy())
+                self._store[k] = nd_array(synced, ctx=v.context)
+            else:
+                self._store[k] = v.copy()
 
     def _reduce(self, vlist):
         """Sum values living on (possibly) different devices onto the first
@@ -111,6 +125,13 @@ class KVStore:
             merged = self._reduce(vlist)
             if self._compression is not None:
                 merged = self._compress(k, merged)
+            if self._dist:
+                # cross-process sum: sync parameter-server aggregation
+                # (kvstore_dist_server.h ApplyUpdates :282) as a collective
+                from . import dist
+                from .ndarray.ndarray import array as nd_array
+                summed = dist.allreduce_sum(merged.asnumpy())
+                merged = nd_array(summed, ctx=merged.context)
             stored = self._store[k]
             if self._updater is not None:
                 merged = merged.as_in_context(stored.context)
@@ -214,10 +235,8 @@ class KVStore:
     # -- distributed --------------------------------------------------------
     def _barrier(self):
         if "dist" in self._kind:
-            import jax
-            # all processes join a tiny collective — the TPU-native barrier
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+            from . import dist
+            dist.barrier("mxnet_tpu_kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
         pass  # no external servers: optimizer already runs in-process
@@ -242,6 +261,7 @@ def create(name="local"):
 
 def quantize_2bit(arr, residual, threshold):
     """Returns (packed float32 words, new_residual). Vectorized numpy."""
+    threshold = _np.float32(threshold)   # keep the residual float32
     flat = arr.astype(_np.float32).ravel() + residual.ravel()
     pos = flat >= threshold
     neg = flat <= -threshold

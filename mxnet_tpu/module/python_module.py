@@ -88,10 +88,8 @@ class PythonModule(BaseModule):
 
     def _compute_output_shapes(self):
         """Default: outputs mirror the data shapes."""
-        return [(name, shape[1])
-                for name, shape in zip(self._output_names,
-                                       [(d[0], d[1])
-                                        for d in self._data_shapes])]
+        return [(name, d[1])
+                for name, d in zip(self._output_names, self._data_shapes)]
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

@@ -24,15 +24,28 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "waitall", "imdecode", "moveaxis"]
 
 
+_DEV_CTX_CACHE = {}
+
+
 def _dev_ctx(data) -> Context:
     try:
         dev = list(data.devices())[0] if hasattr(data, "devices") else data.device
     except Exception:
         return current_context()
+    ctx = _DEV_CTX_CACHE.get(dev)
+    if ctx is not None:
+        return ctx
     plat = getattr(dev, "platform", "cpu")
-    if plat == "cpu":
-        return Context("cpu", dev.id)
-    return Context("tpu", dev.id)
+    # Context ids are process-LOCAL indices: under jax.distributed the raw
+    # dev.id is a global ordinal (e.g. 2048 on worker 1)
+    try:
+        import jax
+        idx = jax.local_devices(backend=plat).index(dev)
+    except Exception:
+        idx = dev.id
+    ctx = Context("cpu" if plat == "cpu" else "tpu", idx)
+    _DEV_CTX_CACHE[dev] = ctx
+    return ctx
 
 
 def _invoke(name, *inputs, **kwargs):

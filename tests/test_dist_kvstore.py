@@ -1,0 +1,90 @@
+"""Multi-process distributed kvstore value tests.
+
+Reference pattern: tests/nightly/dist_sync_kvstore.py:19-68 — N forked
+workers push known values into a dist_sync store and assert the bitwise
+expected aggregate, launched through the local tracker (tools/launch.py).
+Here the workers are real processes joined via jax.distributed over a Gloo
+CPU backend.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+n = int(os.environ["DMLC_NUM_WORKER"])
+out_dir = sys.argv[1]
+
+kv = mx.kv.create("dist_sync")
+assert kv.rank == rank and kv.num_workers == n, (kv.rank, kv.num_workers)
+
+# init: every worker must see rank 0's value
+init_val = np.full((3, 4), 7.0 if rank == 0 else -99.0, np.float32)
+kv.init("w", mx.nd.array(init_val))
+out = mx.nd.zeros((3, 4))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), 7.0)
+
+# push without updater: store <- sum over workers
+kv.push("w", mx.nd.array(np.full((3, 4), float(rank + 1), np.float32)))
+kv.pull("w", out=out)
+expected = sum(range(1, n + 1))
+np.testing.assert_allclose(out.asnumpy(), expected)
+
+# push with a per-worker device list: local reduce then global sum
+kv2_val = [mx.nd.array(np.full((2,), float(rank), np.float32)),
+           mx.nd.array(np.full((2,), 1.0, np.float32))]
+kv.init("w2", mx.nd.zeros((2,)))
+kv.push("w2", kv2_val)
+out2 = mx.nd.zeros((2,))
+kv.pull("w2", out=out2)
+expected2 = sum(r + 1.0 for r in range(n))
+np.testing.assert_allclose(out2.asnumpy(), expected2)
+
+# updater path: sgd-like updates applied identically in each process
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, wd=0.0))
+kv.init("w3", mx.nd.zeros((4,)))
+for step in range(3):
+    kv.push("w3", mx.nd.array(np.full((4,), float(rank + 1), np.float32)))
+out3 = mx.nd.zeros((4,))
+kv.pull("w3", out=out3)
+np.testing.assert_allclose(out3.asnumpy(),
+                           -0.5 * 3 * sum(range(1, n + 1)), rtol=1e-6)
+
+kv._barrier()
+with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+    f.write("pass")
+print(f"worker {rank}: PASS", flush=True)
+"""
+
+
+def test_dist_sync_kvstore_three_workers():
+    n = 3
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_NUM_CPU_DEVICES"] = "1"   # conftest's 8-device mesh leaks
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", str(n), "--launcher", "local",
+             sys.executable, script, td],
+            env=env, capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, \
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        for r in range(n):
+            assert os.path.exists(os.path.join(td, f"ok_{r}")), \
+                f"worker {r} did not finish:\n{proc.stdout}\n{proc.stderr}"

@@ -126,6 +126,12 @@ def test_group2ctx_executes():
     for k in ex.arg_dict:
         ex.arg_dict[k][:] = rng.normal(size=ex.arg_dict[k].shape)
     res = ex.forward(is_train=True)[0]
+    # outputs of the dev2 group REALLY live on cpu:1 (placement, not just
+    # numerics — in-jit device_put is a no-op, so the MP path must run
+    # eagerly segmented)
+    import jax
+    assert list(res._data.devices())[0] == jax.local_devices(
+        backend="cpu")[1]
     # numerics identical to the unplaced graph
     ref = out.simple_bind(mx.cpu(0), a=(2, 6))
     for k in ref.arg_dict:
@@ -134,6 +140,10 @@ def test_group2ctx_executes():
     np.testing.assert_allclose(res.asnumpy(), want, rtol=1e-5)
     ex.backward()
     assert ex.grad_dict["fc1_weight"].asnumpy().shape == (8, 6)
+    # eval path places too
+    res_eval = ex.forward(is_train=False)[0]
+    assert list(res_eval._data.devices())[0] == jax.local_devices(
+        backend="cpu")[1]
 
 
 def test_group2ctx_mesh_conflict():

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Distributed job launcher — the dmlc-tracker replacement.
+
+Parity target: tools/launch.py (reference :99-115), which dispatches
+ssh/mpi/sge/yarn/local trackers and exports DMLC_* env vars. Here the
+cluster runtime is jax.distributed: every launched process joins one job
+via a GRPC coordinator, so there are no separate server/scheduler roles —
+"-n workers" is the whole world.
+
+Supported launchers:
+  local  — fork N worker processes on this machine (the reference's
+           `--launcher local` used by tests/nightly/dist_sync_kvstore.py)
+  manual — print the env each remote worker must export, then run worker 0
+
+Usage: python tools/launch.py -n 4 [--launcher local] python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker_env(rank, num_workers, uri, port):
+    env = dict(os.environ)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": uri,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": "0",            # no server role TPU-natively
+        "DMLC_WORKER_ID": str(rank),
+    })
+    return env
+
+
+def launch_local(num_workers, command):
+    uri, port = "127.0.0.1", _free_port()
+    procs = []
+    for rank in range(num_workers):
+        procs.append(subprocess.Popen(
+            command, env=worker_env(rank, num_workers, uri, port)))
+    rc = 0
+    for rank, p in enumerate(procs):
+        code = p.wait()
+        if code != 0:
+            print(f"worker {rank} exited with {code}", file=sys.stderr)
+            rc = rc or code
+    return rc
+
+
+def launch_manual(num_workers, command, uri, port):
+    print("# export on each remote host (rank = 0..n-1):")
+    for k, v in worker_env("<rank>", num_workers, uri, port).items():
+        if k.startswith("DMLC_"):
+            print(f"export {k}={v}")
+    print("# then run:", " ".join(command))
+    p = subprocess.Popen(command, env=worker_env(0, num_workers, uri, port))
+    return p.wait()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=("local", "manual"),
+                    default="local")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="coordinator host (manual launcher)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="coordinator port (manual launcher; 0 = pick)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command)
+    return launch_manual(args.num_workers, args.command, args.host,
+                         args.port or _free_port())
+
+
+if __name__ == "__main__":
+    sys.exit(main())
