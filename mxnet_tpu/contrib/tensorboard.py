@@ -1,0 +1,48 @@
+"""TensorBoard logging bridge.
+
+Parity target: python/mxnet/contrib/tensorboard.py:25 LogMetricsCallback —
+a batch-end callback streaming EvalMetric values into a TensorBoard event
+file. The writer dependency is optional: tries `tensorboardX`, then
+`torch.utils.tensorboard` (bundled with the cpu torch in this image).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _make_writer(logging_dir):
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError as e:
+        raise MXNetError(
+            "LogMetricsCallback needs a SummaryWriter: install tensorboardX "
+            "or torch") from e
+
+
+class LogMetricsCallback:
+    """Batch-end callback: write each metric as a scalar.
+
+    Usage: mod.fit(..., batch_end_callback=LogMetricsCallback('logs/train'))
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,8 @@
+"""mx.contrib.text — vocabularies and token embeddings.
+
+Parity target: python/mxnet/contrib/text/ (SURVEY.md §2.4 contrib py).
+"""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

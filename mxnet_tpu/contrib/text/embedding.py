@@ -1,0 +1,219 @@
+"""Token embeddings — pretrained-vector loading and lookup.
+
+Parity target: python/mxnet/contrib/text/embedding.py. `_TokenEmbedding`
+extends Vocabulary with an `idx_to_vec` matrix; `CustomEmbedding` loads any
+local `token<delim>v1 v2 ...` file; `GloVe`/`FastText` expose the reference
+registry names but, in this zero-egress build, require the pretrained file
+to already exist under `embedding_root` (no downloads).
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "_TokenEmbedding", "CustomEmbedding", "GloVe", "FastText",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Class decorator registering an embedding under its lowercase name."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    cls = _REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise MXNetError(f"unknown embedding {embedding_name!r}; "
+                         f"registered: {sorted(_REGISTRY)}")
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise MXNetError(f"unknown embedding {embedding_name!r}")
+        return list(cls.pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _REGISTRY.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + vectors; subclasses load a pretrained file."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, path, elem_delim,
+                        init_unknown_vec=_np.zeros, encoding="utf8"):
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"pretrained embedding file {path!r} not found — this build "
+                "has no network egress; place the file there manually")
+        vecs = {}
+        vec_len = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if line_num == 0 and len(elems) == 1 and \
+                        token.isdigit() and elems[0].strip().isdigit():
+                    continue   # fastText header "count dim" (two integers)
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    raise MXNetError(
+                        f"line {line_num + 1} of {path}: vector length "
+                        f"{len(elems)} != {vec_len}")
+                if token in vecs:
+                    continue
+                vecs[token] = _np.asarray([float(x) for x in elems],
+                                          _np.float32)
+        if vec_len is None:
+            raise MXNetError(f"no vectors found in {path}")
+        self._vec_len = vec_len
+        for token in vecs:
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        mat = _np.empty((len(self), vec_len), _np.float32)
+        mat[0] = init_unknown_vec(vec_len)
+        for i, token in enumerate(self._idx_to_token):
+            if i == 0:
+                continue
+            mat[i] = vecs.get(token, mat[0])
+        from ... import nd
+        self._idx_to_vec = nd.array(mat)
+
+    def _build_from_vocabulary(self, vocabulary, source_embeddings):
+        """Restrict `source_embeddings` to `vocabulary`'s tokens
+        (embedding.py _build_embedding_for_vocabulary :344)."""
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._vec_len = sum(e.vec_len for e in source_embeddings)
+        mat = _np.zeros((len(self), self._vec_len), _np.float32)
+        for i, token in enumerate(self._idx_to_token):
+            off = 0
+            for e in source_embeddings:
+                mat[i, off:off + e.vec_len] = \
+                    e.get_vecs_by_tokens(token).asnumpy()
+                off += e.vec_len
+        from ... import nd
+        self._idx_to_vec = nd.array(mat)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
+        else:
+            idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        from ... import nd
+        return nd.array(vecs[0] if single else vecs)
+
+    def _restrict(self, vocabulary):
+        """Rebuild this embedding over `vocabulary`'s tokens only."""
+        restricted = _TokenEmbedding()
+        restricted._build_from_vocabulary(vocabulary, [self])
+        self.__dict__.update(restricted.__dict__)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        from ...ndarray.ndarray import NDArray
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        vals = new_vectors.asnumpy() \
+            if isinstance(new_vectors, NDArray) else _np.asarray(new_vectors)
+        vals = vals.reshape(len(toks), -1)
+        mat = self._idx_to_vec.asnumpy().copy()   # jax buffers are read-only
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not indexed")
+            mat[self._token_to_idx[t]] = v
+        from ... import nd
+        self._idx_to_vec = nd.array(mat)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user file `token<elem_delim>v1<elem_delim>v2...`."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=_np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._restrict(vocabulary)
+
+
+@register
+class GloVe(_TokenEmbedding):
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=_np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = os.path.join(os.path.expanduser(embedding_root), "glove",
+                            pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._restrict(vocabulary)
+
+
+@register
+class FastText(GloVe):
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "cc.en.300.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=_np.zeros, vocabulary=None, **kwargs):
+        _TokenEmbedding.__init__(self, **kwargs)
+        path = os.path.join(os.path.expanduser(embedding_root), "fasttext",
+                            pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._restrict(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._build_from_vocabulary(vocabulary, token_embeddings)

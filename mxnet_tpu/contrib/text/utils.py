@@ -1,0 +1,21 @@
+"""Text utilities (parity: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in `source_str` split by the delimiters; returns (or
+    updates) a collections.Counter."""
+    source_str = re.split(f"{token_delim}|{seq_delim}", source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    if counter_to_update is None:
+        return Counter(tokens)
+    counter_to_update.update(tokens)
+    return counter_to_update

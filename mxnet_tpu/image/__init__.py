@@ -15,6 +15,13 @@ from .image import (imdecode, imresize, imread, resize_short, fixed_crop,
                     RandomGrayAug)
 from .io import ImageRecordIter
 
+from .detection import (ImageDetIter, CreateDetAugmenter,  # noqa: E402
+                        DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomSelectAug)
+
 __all__ = ["imdecode", "imresize", "imread", "resize_short", "fixed_crop",
            "random_crop", "center_crop", "color_normalize", "ImageIter",
-           "CreateAugmenter", "ImageRecordIter", "Augmenter"]
+           "CreateAugmenter", "ImageRecordIter", "Augmenter",
+           "ImageDetIter", "CreateDetAugmenter", "DetBorrowAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetRandomSelectAug"]

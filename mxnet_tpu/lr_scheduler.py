@@ -1,7 +1,11 @@
 """Learning-rate schedulers.
 
-Parity target: python/mxnet/lr_scheduler.py (SURVEY.md §2.4) — FactorScheduler,
-MultiFactorScheduler, PolyScheduler keyed on num_update.
+Parity surface: python/mxnet/lr_scheduler.py (SURVEY.md §2.4) —
+FactorScheduler, MultiFactorScheduler, PolyScheduler keyed on num_update.
+
+Own design: each schedule is a pure function of `num_update` (no stateful
+catch-up loops) — the decay count is computed closed-form, which also makes
+the schedulers trivially checkpoint-safe.
 """
 from __future__ import annotations
 
@@ -12,88 +16,75 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
+    """Base: maps the optimizer's update counter to a learning rate."""
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
     def __call__(self, num_update):
         raise NotImplementedError
 
+    def _log_if_changed(self, num_update, lr):
+        last = getattr(self, "_last_lr", None)
+        self._last_lr = lr
+        if last is not None and lr != last:
+            logging.info("Update[%d]: learning rate is now %0.5e",
+                         num_update, lr)
+        return lr
+
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates, floored at stop_factor_lr."""
+    """lr = base_lr * factor^k after every `step` updates, floored at
+    stop_factor_lr. Decay k happens once num_update exceeds k*step."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 (lr must not grow)")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+        decays = max(0, (num_update - 1) // self.step)
+        lr = max(self.base_lr * self.factor ** decays, self.stop_factor_lr)
+        return self._log_if_changed(num_update, lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each listed update step."""
+    """lr *= factor when num_update passes each milestone in `step`."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("milestones must be >= 1")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("milestones must be strictly increasing")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 (lr must not grow)")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        decays = sum(1 for s in self.step if num_update > s)
+        lr = self.base_lr * self.factor ** decays
+        return self._log_if_changed(num_update, lr)
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay from base_lr to 0 over max_update steps."""
+    """Polynomial decay base_lr * (1 - t/T)^power down to 0 at T."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = self.base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive int")
         self.max_update = max_update
         self.power = pwr
-        self.base_lr = self.base_lr_orig
 
     def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
-        return self.base_lr
+        t = min(num_update, self.max_update)
+        return self.base_lr * (1.0 - t / self.max_update) ** self.power

@@ -1,83 +1,105 @@
 """Monitor — per-op output introspection during training.
 
-Parity target: python/mxnet/monitor.py (SURVEY.md §2.4) — taps every op
-output via the executor monitor callback (graph_executor.cc:1451; here the
-Executor's un-fused monitored forward path).
+Parity surface: python/mxnet/monitor.py (SURVEY.md §2.4); the tap point is
+the Executor monitor callback (reference: graph_executor.cc:1451; here the
+un-fused monitored forward path). Own design: the monitor is a window
+recorder — `tic()` opens a recording window every `interval` steps,
+executor callbacks append (step, name, stat) records while it is open, and
+`toc()` closes the window, appends final-output stats, and renders.
 """
 from __future__ import annotations
 
 import logging
 import re
-import time
 
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Monitor"]
 
 
-class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return x.abs().mean()
-            stat_func = asum_stat
-        self.stat_func = stat_func
-        self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
+def _mean_abs(x):
+    """Default statistic: mean(|x|)."""
+    return x.abs().mean()
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-        self.stat_helper = stat_helper
+
+def _render_stat(value):
+    """Render a stat result (NDArray or list of NDArrays) to text."""
+    values = value if isinstance(value, list) else [value]
+    parts = []
+    for v in values:
+        if not isinstance(v, NDArray):
+            parts.append(str(v))
+        elif v.shape in ((1,), ()):
+            parts.append(str(v.asscalar()))
+        else:
+            parts.append(str(v.asnumpy()))
+    return "\t".join(parts) + "\t"
+
+
+class Monitor:
+    """Record statistics of intermediate outputs every `interval` batches.
+
+    stat_func: NDArray -> NDArray (or list), default mean(|x|).
+    pattern: regex filtering tapped entry names.
+    sort: sort records by entry name before rendering.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or _mean_abs
+        self.sort = sort
+        self._name_filter = re.compile(pattern)
+        self._records = []
+        self._window_open = False
+        self.step = 0
+        self._executors = []
+
+    # Executor callback contract: fn(entry_name, NDArray)
+    def __call__(self, name, array):
+        if self._window_open and self._name_filter.match(name):
+            self._records.append((self.step, name, self.stat_func(array)))
+
+    # legacy attribute alias (reference exposes .stat_helper)
+    @property
+    def stat_helper(self):
+        return self
 
     def install(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        """Attach to an executor (the monitor itself is the callback)."""
+        exe.set_monitor_callback(self)
+        self._executors.append(exe)
+
+    def _drain(self):
+        """Block until attached executors' params are materialized, so the
+        stats reflect this step (the engine WaitToRead role)."""
+        for exe in self._executors:
+            for arr in exe.arg_arrays:
+                arr.wait_to_read()
 
     def tic(self):
+        """Open a recording window if this step is on the interval."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
+            self._drain()
+            self._records = []
+            self._window_open = True
         self.step += 1
 
     def toc(self):
-        if not self.activated:
+        """Close the window; returns [(step, name, rendered_stat)]."""
+        if not self._window_open:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe._output_names, exe.outputs):
-                self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        self._drain()
+        for exe in self._executors:
+            for name, out in zip(exe._output_names, exe.outputs):
+                self._records.append((self.step, name, self.stat_func(out)))
+        self._window_open = False
+        records = sorted(self._records, key=lambda r: r[1]) if self.sort \
+            else list(self._records)
+        self._records = []
+        return [(step, name, _render_stat(val))
+                for (step, name, val) in records]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() + log each record."""
+        for step, name, text in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, text)

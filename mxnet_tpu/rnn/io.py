@@ -1,12 +1,23 @@
-"""RNN data iterators (parity: python/mxnet/rnn/io.py): BucketSentenceIter +
-encode_sentences — feeds BucketingModule with per-bucket fixed shapes (the
-TPU-honest answer to variable sequence length, SURVEY.md §5)."""
+"""RNN data iterators — bucketed sentence batching.
+
+Parity surface: python/mxnet/rnn/io.py (BucketSentenceIter,
+encode_sentences), feeding BucketingModule with per-bucket fixed shapes —
+the TPU-honest answer to variable sequence length (SURVEY.md §5): one
+compiled program per bucket length instead of dynamic shapes.
+
+Own design: sentences are binned once into dense per-bucket matrices
+(vectorized padding), language-model labels are the data shifted left by
+one, and the epoch is a shuffled list of (bucket, row-offset) batch
+cursors.
+"""
 from __future__ import annotations
 
-import random
+import logging
+import random as _pyrandom
 
 import numpy as np
 
+from ..base import MXNetError
 from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray.ndarray import array
 
@@ -15,131 +26,126 @@ __all__ = ["BucketSentenceIter", "encode_sentences"]
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0, unknown_token=None):
-    """Encode sentences to int arrays, building vocab on the fly
-    (rnn/io.py encode_sentences)."""
-    idx = start_label
-    if vocab is None:
+    """Map token sequences to int id sequences, growing `vocab` when it was
+    not supplied. Unknown words either extend the vocab (building mode),
+    map to `unknown_token`, or error."""
+    building = vocab is None
+    if building:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
+    next_id = start_label
+    encoded = []
+    for sentence in sentences:
+        ids = []
+        for word in sentence:
             if word not in vocab:
-                assert new_vocab or unknown_token, \
-                    f"Unknown token {word}"
-                if idx == invalid_label:
-                    idx += 1
+                if not building and not unknown_token:
+                    raise MXNetError(f"unknown token {word!r} and no "
+                                     "unknown_token fallback")
                 if unknown_token:
                     word = unknown_token
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+                if word not in vocab:
+                    if next_id == invalid_label:
+                        next_id += 1
+                    vocab[word] = next_id
+                    next_id += 1
+            ids.append(vocab[word])
+        encoded.append(ids)
+    return encoded, vocab
+
+
+def _auto_buckets(lengths, batch_size):
+    """One bucket per sentence length that has at least a full batch."""
+    counts = np.bincount(lengths)
+    return [int(ln) for ln in np.nonzero(counts >= batch_size)[0] if ln > 0]
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketed iterator over variable-length sentences."""
+    """Iterate fixed-shape batches of padded sentences, bucketed by length.
 
-    def __init__(self, sentences, batch_size, buckets=None,
-                 invalid_label=-1, data_name="data", label_name="softmax_label",
+    Layout 'NT' yields (batch, time); 'TN' yields (time, batch). Labels are
+    the next-token shift of the data (language-model convention).
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label",
                  dtype="float32", layout="NT"):
         super().__init__(batch_size)
+        if layout not in ("NT", "TN"):
+            raise MXNetError(f"layout must be 'NT' or 'TN', got {layout!r}")
+        lengths = [len(s) for s in sentences]
         if not buckets:
-            buckets = [i for i, j in enumerate(
-                np.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
+            buckets = _auto_buckets(lengths, batch_size)
+        self.buckets = sorted(buckets)
+        if not self.buckets:
+            raise MXNetError("no buckets: provide `buckets` explicitly")
+
+        # bin sentences: smallest bucket that fits; overflow is dropped
+        per_bucket = [[] for _ in self.buckets]
+        dropped = 0
         for sent in sentences:
-            buck = np.searchsorted(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
+            slot = int(np.searchsorted(self.buckets, len(sent)))
+            if slot == len(self.buckets):
+                dropped += 1
                 continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            import logging
-            logging.warning("discarded %d sentences longer than the largest "
-                            "bucket.", ndiscard)
+            per_bucket[slot].append(sent)
+        if dropped:
+            logging.warning("BucketSentenceIter: dropped %d sentences "
+                            "longer than the largest bucket", dropped)
+        # dense padded matrix per bucket
+        self._bucket_data = []
+        for width, sents in zip(self.buckets, per_bucket):
+            mat = np.full((len(sents), width), invalid_label, dtype=dtype)
+            for r, sent in enumerate(sents):
+                mat[r, :len(sent)] = sent
+            self._bucket_data.append(mat)
 
         self.batch_size = batch_size
-        self.buckets = buckets
+        self.invalid_label = invalid_label
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
-        self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = layout.find("N")
         self.layout = layout
-        self.default_bucket_key = max(buckets)
+        self.major_axis = layout.find("N")
+        self.default_bucket_key = max(self.buckets)
+        shape = (batch_size, self.default_bucket_key) \
+            if layout == "NT" else (self.default_bucket_key, batch_size)
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
 
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(batch_size, self.default_bucket_key), layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(batch_size, self.default_bucket_key), layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(self.default_bucket_key, batch_size), layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(self.default_bucket_key, batch_size), layout=layout)]
-        else:
-            raise ValueError(
-                "Invalid layout %s: Must by NT (batch major) or TN (time "
-                "major)" % layout)
-
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
-        self.curr_idx = 0
+        self._cursors = []
+        self._pos = 0
         self.reset()
 
+    def _shift_labels(self, mat):
+        lab = np.roll(mat, -1, axis=1)
+        lab[:, -1] = self.invalid_label
+        return lab
+
     def reset(self):
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(buck)
-            self.ndlabel.append(label)
+        self._pos = 0
+        for mat in self._bucket_data:
+            np.random.shuffle(mat)
+        self._labels = [self._shift_labels(m) for m in self._bucket_data]
+        self._cursors = [
+            (b, row)
+            for b, mat in enumerate(self._bucket_data)
+            for row in range(0, len(mat) - self.batch_size + 1,
+                             self.batch_size)]
+        _pyrandom.shuffle(self._cursors)
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._pos >= len(self._cursors):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
-
-        if self.major_axis == 1:
-            data = array(self.nddata[i][j:j + self.batch_size].T)
-            label = array(self.ndlabel[i][j:j + self.batch_size].T)
-        else:
-            data = array(self.nddata[i][j:j + self.batch_size])
-            label = array(self.ndlabel[i][j:j + self.batch_size])
-
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(
-                             name=self.data_name, shape=data.shape,
-                             layout=self.layout)],
-                         provide_label=[DataDesc(
-                             name=self.label_name, shape=label.shape,
-                             layout=self.layout)])
+        b, row = self._cursors[self._pos]
+        self._pos += 1
+        data = self._bucket_data[b][row:row + self.batch_size]
+        label = self._labels[b][row:row + self.batch_size]
+        if self.layout == "TN":
+            data, label = data.T, label.T
+        data, label = array(data), array(label)
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[b],
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

@@ -1,0 +1,164 @@
+"""contrib.text / tensorboard bridge / ImageDetIter tests.
+
+Reference patterns: tests/python/unittest/test_contrib_text.py and the
+ImageDetIter paths of tests/python/unittest/test_image.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.utils.count_tokens_from_str("a b b\nc c c")
+    assert c["a"] == 1 and c["b"] == 2 and c["c"] == 3
+    c2 = text.utils.count_tokens_from_str("A a", to_lower=True)
+    assert c2["a"] == 2
+
+
+def test_vocabulary():
+    counter = text.utils.count_tokens_from_str("b b b a a c d d d d")
+    v = text.Vocabulary(counter, most_freq_count=3, min_freq=2,
+                        reserved_tokens=["<pad>"])
+    # idx 0 unk, idx 1 <pad>, then d(4), b(3), a(2)
+    assert len(v) == 5
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "b", "a"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["a", "zzz"]) == [4, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "d"]
+    with pytest.raises(mx.MXNetError):
+        v.to_tokens(99)
+
+
+def test_custom_embedding(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("world").asnumpy(),
+                               [4, 5, 6])
+    # unknown -> zeros
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("zzz").asnumpy(),
+                               [0, 0, 0])
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("hello").asnumpy(), 9.0)
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("a 1 1\nb 2 2\nc 3 3\n")
+    counter = text.utils.count_tokens_from_str("a a b x")
+    vocab = text.Vocabulary(counter)
+    emb = text.embedding.CustomEmbedding(str(p), vocabulary=vocab)
+    assert len(emb) == len(vocab)
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("a").asnumpy(), [1, 1])
+    # token in vocab but not in the file -> unknown vector
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("x").asnumpy(), [0, 0])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "e1.txt"
+    p1.write_text("a 1 1\nb 2 2\n")
+    p2 = tmp_path / "e2.txt"
+    p2.write_text("a 7\nb 8\n")
+    vocab = text.Vocabulary(text.utils.count_tokens_from_str("a b"))
+    comp = text.embedding.CompositeEmbedding(
+        vocab, [text.embedding.CustomEmbedding(str(p1)),
+                text.embedding.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(comp.get_vecs_by_tokens("a").asnumpy(),
+                               [1, 1, 7])
+
+
+def test_embedding_registry():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    with pytest.raises(mx.MXNetError):
+        text.embedding.create("nope")
+    # zero-egress: missing pretrained file raises a clear error
+    with pytest.raises(mx.MXNetError, match="no network egress"):
+        text.embedding.create("glove", embedding_root="/nonexistent")
+
+
+def test_tensorboard_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    try:
+        cb = LogMetricsCallback(str(tmp_path / "tb"))
+    except mx.MXNetError:
+        pytest.skip("no SummaryWriter backend available")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1.0, 0.0])],
+                  [mx.nd.array([[0.1, 0.9], [0.2, 0.8]])])
+
+    class P:
+        eval_metric = metric
+
+    cb(P())
+    files = list((tmp_path / "tb").glob("*"))
+    assert files, "no event file written"
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    import io as _io
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_image_det_iter(tmp_path):
+    rng = np.random.RandomState(0)
+    files = []
+    for i in range(6):
+        arr = rng.randint(0, 255, (40, 50, 3), np.uint8)
+        f = tmp_path / f"img{i}.png"
+        f.write_bytes(_png_bytes(arr))
+        files.append(str(f))
+    # header: [4, 5, extra, extra], objects (id, x1, y1, x2, y2)
+    imglist = []
+    for i, f in enumerate(files):
+        nobj = 1 + i % 2
+        label = [4, 5, 0, 0]
+        for j in range(nobj):
+            label += [float(j % 3), 0.1, 0.2, 0.6, 0.7]
+        imglist.append([label, f])
+    it = mx.image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=str(tmp_path),
+                               rand_mirror=True)
+    assert it.provide_label[0].shape == (3, 2, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (3, 2, 5)
+    # first image has one object; second row padded with -1
+    assert lab[0, 0, 0] >= 0
+    assert (lab[0, 1] == -1).all()
+    # coordinates remain within [0, 1] (mirror-safe)
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+    # feeds MultiBoxTarget directly
+    anchors = mx.nd.contrib.MultiBoxPrior(mx.nd.zeros((1, 3, 8, 8)),
+                                          sizes=(0.4,))
+    tgt = mx.nd.contrib.MultiBoxTarget(anchors, batch.label[0],
+                                       mx.nd.zeros((3, 4, 64)))
+    assert tgt[2].shape == (3, 64)
+
+
+def test_image_det_iter_reshape():
+    rng = np.random.RandomState(1)
+    arr = rng.randint(0, 255, (20, 20, 3), np.uint8)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        f = os.path.join(td, "a.png")
+        with open(f, "wb") as fh:
+            fh.write(_png_bytes(arr))
+        it = mx.image.ImageDetIter(
+            batch_size=1, data_shape=(3, 16, 16),
+            imglist=[[[2, 5, 1, 0.0, 0.0, 0.5, 0.5], f]], path_root=td)
+        it.reshape(data_shape=(3, 8, 8), label_shape=(4, 5))
+        b = it.next()
+        assert b.data[0].shape == (1, 3, 8, 8)
+        assert b.label[0].shape == (1, 4, 5)
