@@ -1,0 +1,193 @@
+"""Native runtime bindings — loads (building on demand) the C++ library.
+
+The reference's host-side runtime (recordio chunk reader, gradient
+compression, image batch assembly) is C++; src/runtime_native.cc is the
+TPU build's equivalent. Bound through ctypes over a plain C ABI (pybind11
+is deliberately avoided — see the Environment constraints). Everything has
+a pure-python fallback: `lib()` returns None when no compiler is
+available, and callers degrade gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as _np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src", "runtime_native.cc")
+
+
+def _build_dir():
+    d = os.environ.get("MXNET_TPU_NATIVE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "mxnet_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile():
+    out = os.path.join(_build_dir(), "libmxnet_tpu_runtime.so")
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+           _SRC, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        # no OpenMP? retry without
+        try:
+            cmd.remove("-fopenmp")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    return out
+
+
+def _bind(path):
+    lib = ctypes.CDLL(path)
+    L = ctypes.c_long
+    P_L = ctypes.POINTER(ctypes.c_long)
+    P_F = ctypes.POINTER(ctypes.c_float)
+    P_U8 = ctypes.POINTER(ctypes.c_ubyte)
+    P_U32 = ctypes.POINTER(ctypes.c_uint32)
+    lib.mxio_version.restype = ctypes.c_int
+    lib.mxio_scan_records.restype = L
+    lib.mxio_scan_records.argtypes = [ctypes.c_char_p, P_L, P_L, L]
+    lib.mxio_read_records.restype = ctypes.c_int
+    lib.mxio_read_records.argtypes = [ctypes.c_char_p, P_L, P_L, L, P_U8]
+    lib.mxio_quantize_2bit.restype = None
+    lib.mxio_quantize_2bit.argtypes = [P_F, P_F, P_U32, L, ctypes.c_float]
+    lib.mxio_dequantize_2bit.restype = None
+    lib.mxio_dequantize_2bit.argtypes = [P_U32, P_F, L, ctypes.c_float]
+    lib.mxio_hwc_u8_to_chw_f32.restype = None
+    lib.mxio_hwc_u8_to_chw_f32.argtypes = [P_U8, P_F, L, L, L, P_F, P_F]
+    return lib
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_TPU_DISABLE_NATIVE"):
+            return None
+        try:
+            path = _compile()
+            if path:
+                _lib = _bind(path)
+        except OSError:
+            _lib = None
+    return _lib
+
+
+# -- typed convenience wrappers (numpy in/out) ------------------------------
+
+def scan_records(path):
+    """Record (offset, length) table of a .rec file, or None if the native
+    lib is unavailable. Raises IOError on corrupt framing."""
+    L = lib()
+    if L is None:
+        return None
+    n = L.mxio_scan_records(path.encode(), None, None, 0)
+    if n < 0:
+        raise IOError(f"corrupt recordio file: {path}")
+    offsets = _np.zeros(n, _np.int64)
+    lengths = _np.zeros(n, _np.int64)
+    got = L.mxio_scan_records(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), n)
+    if got != n:
+        raise IOError(f"recordio file changed while scanning: {path}")
+    return offsets, lengths
+
+
+def read_records(path, offsets, lengths):
+    """Gather records into a list of bytes objects (native chunk read)."""
+    L = lib()
+    if L is None:
+        return None
+    offsets = _np.ascontiguousarray(offsets, _np.int64)
+    lengths = _np.ascontiguousarray(lengths, _np.int64)
+    total = int(lengths.sum())
+    buf = _np.zeros(total, _np.uint8)
+    rc = L.mxio_read_records(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(offsets),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)))
+    if rc != 0:
+        raise IOError(f"recordio read failed: {path}")
+    out, pos = [], 0
+    for ln in lengths:
+        out.append(buf[pos:pos + ln].tobytes())
+        pos += int(ln)
+    return out
+
+
+def quantize_2bit(grad, residual, threshold):
+    """Native packed 2-bit quantization; returns (packed_f32, residual) or
+    None. `residual` is updated in place (must be float32 contiguous)."""
+    L = lib()
+    if L is None:
+        return None
+    grad = _np.ascontiguousarray(grad, _np.float32).ravel()
+    # fresh residual buffer: the numpy fallback never mutates its input,
+    # so the native path must not either
+    residual = _np.array(residual, _np.float32)
+    flat_res = residual.ravel()
+    n = grad.size
+    out = _np.zeros((n + 15) // 16, _np.uint32)
+    L.mxio_quantize_2bit(
+        grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat_res.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        n, threshold)
+    return out.view(_np.float32), residual
+
+
+def dequantize_2bit(packed, n, threshold):
+    L = lib()
+    if L is None:
+        return None
+    words = _np.ascontiguousarray(packed).view(_np.uint32)
+    out = _np.zeros(n, _np.float32)
+    L.mxio_dequantize_2bit(
+        words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, threshold)
+    return out
+
+
+def hwc_u8_to_chw_f32(img, mean=None, std=None):
+    """uint8 HWC image -> normalized float32 CHW (native loop), or None."""
+    L = lib()
+    if L is None:
+        return None
+    img = _np.ascontiguousarray(img, _np.uint8)
+    h, w, c = img.shape
+    out = _np.zeros((c, h, w), _np.float32)
+    fptr = ctypes.POINTER(ctypes.c_float)
+    mean_arr = None if mean is None else \
+        _np.ascontiguousarray(mean, _np.float32)
+    stdinv_arr = None if std is None else \
+        _np.ascontiguousarray(1.0 / _np.asarray(std, _np.float32))
+    L.mxio_hwc_u8_to_chw_f32(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        out.ctypes.data_as(fptr), h, w, c,
+        mean_arr.ctypes.data_as(fptr) if mean_arr is not None else None,
+        stdinv_arr.ctypes.data_as(fptr) if stdinv_arr is not None else None)
+    return out

@@ -108,13 +108,11 @@ class _TokenEmbedding(_vocab.Vocabulary):
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
         self._vec_len = sum(e.vec_len for e in source_embeddings)
-        mat = _np.zeros((len(self), self._vec_len), _np.float32)
-        for i, token in enumerate(self._idx_to_token):
-            off = 0
-            for e in source_embeddings:
-                mat[i, off:off + e.vec_len] = \
-                    e.get_vecs_by_tokens(token).asnumpy()
-                off += e.vec_len
+        # batched: one lookup per source embedding, not per token (a
+        # per-token loop re-materializes the full matrix every call)
+        blocks = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                  for e in source_embeddings]
+        mat = _np.concatenate(blocks, axis=1).astype(_np.float32)
         from ... import nd
         self._idx_to_vec = nd.array(mat)
 

@@ -11,7 +11,8 @@ def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
     """Count tokens in `source_str` split by the delimiters; returns (or
     updates) a collections.Counter."""
-    source_str = re.split(f"{token_delim}|{seq_delim}", source_str)
+    source_str = re.split(
+        f"{re.escape(token_delim)}|{re.escape(seq_delim)}", source_str)
     tokens = [t for t in source_str if t]
     if to_lower:
         tokens = [t.lower() for t in tokens]

@@ -132,7 +132,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_mirror=False,
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
-    if mean is not None:
+    if mean is not None or std is not None:
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
 
@@ -181,13 +181,29 @@ class ImageDetIter(ImageIter):
             raise MXNetError("label body not a multiple of object width")
         return body.reshape(-1, obj_width)
 
-    def _measure_label_shape(self):
-        max_obj, width = 1, 5
+    def _iter_raw_labels(self):
+        """Yield every raw label in the source (imglist or .rec records —
+        the .rec pass rides the native scanner's seek table)."""
         if self.imglist is not None:
             for label, _ in self.imglist.values():
-                parsed = self._parse_label(label)
-                max_obj = max(max_obj, parsed.shape[0])
-                width = max(width, parsed.shape[1])
+                yield label
+        elif self.imgrec is not None:
+            from .. import recordio
+            self.imgrec.reset()
+            while True:
+                s = self.imgrec.read()
+                if s is None:
+                    break
+                header, _ = recordio.unpack(s)
+                yield header.label
+            self.imgrec.reset()
+
+    def _measure_label_shape(self):
+        max_obj, width = 1, 5
+        for label in self._iter_raw_labels():
+            parsed = self._parse_label(label)
+            max_obj = max(max_obj, parsed.shape[0])
+            width = max(width, parsed.shape[1])
         return max_obj, width
 
     def reshape(self, data_shape=None, label_shape=None):
@@ -197,6 +213,13 @@ class ImageDetIter(ImageIter):
                 self.provide_data[0].name,
                 (self.batch_size,) + tuple(data_shape))]
             self.data_shape = tuple(data_shape)
+            # retarget the resize stage — otherwise images are resized to
+            # the old shape and then again in next()
+            for aug in self.det_auglist:
+                if isinstance(aug, DetBorrowAug) and \
+                        isinstance(aug.augmenter, ForceResizeAug):
+                    aug.augmenter = ForceResizeAug((data_shape[2],
+                                                    data_shape[1]))
         if label_shape is not None:
             self.max_objects, self.obj_width = label_shape
             self.provide_label = [io_mod.DataDesc(
@@ -227,7 +250,8 @@ class ImageDetIter(ImageIter):
                 batch_data[i] = np.transpose(
                     np.asarray(data, np.float32), (2, 0, 1))
                 n = min(label.shape[0], self.max_objects)
-                batch_label[i, :n, :label.shape[1]] = label[:n]
+                w_lab = min(label.shape[1], self.obj_width)
+                batch_label[i, :n, :w_lab] = label[:n, :w_lab]
                 i += 1
         except StopIteration:
             if i == 0:

@@ -260,8 +260,14 @@ def create(name="local"):
 # 10 = -threshold, 00 = below threshold.
 
 def quantize_2bit(arr, residual, threshold):
-    """Returns (packed float32 words, new_residual). Vectorized numpy."""
+    """Returns (packed float32 words, new_residual): native C++ kernel
+    (src/runtime_native.cc) when available, vectorized numpy otherwise."""
     threshold = _np.float32(threshold)   # keep the residual float32
+    from . import _native
+    native = _native.quantize_2bit(arr, residual, float(threshold))
+    if native is not None:
+        packed, new_res = native
+        return packed, new_res.reshape(_np.shape(residual))
     flat = arr.astype(_np.float32).ravel() + residual.ravel()
     pos = flat >= threshold
     neg = flat <= -threshold
@@ -278,7 +284,11 @@ def quantize_2bit(arr, residual, threshold):
 
 
 def dequantize_2bit(packed, orig_size, threshold):
-    """Inverse of quantize_2bit: packed float32 words -> float32 values."""
+    """Inverse of quantize_2bit (native kernel when available)."""
+    from . import _native
+    native = _native.dequantize_2bit(packed, orig_size, float(threshold))
+    if native is not None:
+        return native
     words = _np.ascontiguousarray(packed).view(_np.uint32)
     shifts = (30 - 2 * _np.arange(16)).astype(_np.uint32)
     codes = ((words[:, None] >> shifts) & 3).ravel()[:orig_size]

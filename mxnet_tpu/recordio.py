@@ -118,13 +118,25 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
-        if not self.writable and os.path.isfile(self.idx_path):
+        if self.writable:
+            return
+        if self.idx_path and os.path.isfile(self.idx_path):
             with open(self.idx_path) as fin:
                 for line in fin.readlines():
                     line = line.strip().split("\t")
                     key = self.key_type(line[0])
                     self.idx[key] = int(line[1])
                     self.keys.append(key)
+        else:
+            # no .idx: build the seek table by scanning the .rec framing
+            # (native C++ scanner when available — iter_image_recordio_2.cc
+            # chunk-reader role; python fallback otherwise)
+            offsets, _ = scan_record_positions(self.uri)
+            for i, off in enumerate(offsets):
+                key = self.key_type(i)
+                # stored offsets point at the record START (magic word)
+                self.idx[key] = int(off) - 8
+                self.keys.append(key)
 
     def close(self):
         if not self.is_open:
@@ -223,3 +235,30 @@ def _decode_img(s, iscolor=-1):
     import io as _io
     from PIL import Image
     return np.asarray(Image.open(_io.BytesIO(s)))
+
+
+def scan_record_positions(uri):
+    """(payload_offsets, lengths) arrays for every record in a .rec file.
+
+    Native fast path (src/runtime_native.cc mxio_scan_records via ctypes);
+    pure-python framing walk as fallback.
+    """
+    from . import _native
+    out = _native.scan_records(uri)
+    if out is not None:
+        return out
+    offsets, lengths = [], []
+    with open(uri, "rb") as fp:
+        while True:
+            pos = fp.tell()
+            hdr = fp.read(8)
+            if len(hdr) < 8:
+                break
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _kMagic:
+                raise IOError(f"corrupt recordio file: {uri}")
+            length = lrec & ((1 << 29) - 1)
+            offsets.append(pos + 8)
+            lengths.append(length)
+            fp.seek((length + 3) & ~3, 1)
+    return (np.asarray(offsets, np.int64), np.asarray(lengths, np.int64))

@@ -1,0 +1,148 @@
+// Native runtime kernels — the C++ substrate for host-side hot paths.
+//
+// Parity role (SURVEY.md §1 L7, §2.3): the reference implements its data
+// pipeline (dmlc recordio chunk reader, src/io/iter_image_recordio_2.cc)
+// and gradient compression (src/kvstore/gradient_compression.cc) in C++.
+// The TPU build keeps XLA for device compute; these are the host-side
+// equivalents, exposed through a plain C ABI consumed via ctypes
+// (python/mxnet_tpu/_native). No pybind11 — the ABI stays compiler-stable.
+//
+// Format notes:
+//   recordio framing (dmlc-core): [magic 0xced7230a][u32 len word] payload,
+//   padded to 4-byte alignment; the upper 3 bits of the length word are the
+//   continuation flag for split records (unused by im2rec output).
+//   2-bit compression: 16 values per 32-bit word, element j in bits
+//   (31-2j, 30-2j); 11=+threshold, 10=-threshold, 00=below.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+constexpr uint32_t kMagic = 0xced7230au;
+}
+
+extern "C" {
+
+int mxio_version() { return 1; }
+
+// Scan a .rec file, filling offsets[i] (payload start) and lengths[i].
+// Returns the number of records found, or -1 on IO/format error. Pass
+// capacity=0 to count only.
+long mxio_scan_records(const char* path, long* offsets, long* lengths,
+                       long capacity) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  long count = 0;
+  uint32_t head[2];
+  for (;;) {
+    long pos = std::ftell(fp);
+    size_t got = std::fread(head, sizeof(uint32_t), 2, fp);
+    if (got == 0) break;               // clean EOF
+    if (got != 2 || head[0] != kMagic) {
+      std::fclose(fp);
+      return -1;                        // corrupt framing
+    }
+    uint32_t len = head[1] & ((1u << 29) - 1);
+    if (offsets && count < capacity) {
+      offsets[count] = pos + 2 * static_cast<long>(sizeof(uint32_t));
+      lengths[count] = static_cast<long>(len);
+    }
+    ++count;
+    long skip = static_cast<long>((len + 3u) & ~3u);
+    if (std::fseek(fp, skip, SEEK_CUR) != 0) {
+      std::fclose(fp);
+      return -1;
+    }
+  }
+  std::fclose(fp);
+  return count;
+}
+
+// Gather many records into one contiguous buffer (the chunk-read role of
+// iter_image_recordio_2.cc). dst must hold sum(lengths). Returns 0 on
+// success.
+int mxio_read_records(const char* path, const long* offsets,
+                      const long* lengths, long n, unsigned char* dst) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  long written = 0;
+  for (long i = 0; i < n; ++i) {
+    if (std::fseek(fp, offsets[i], SEEK_SET) != 0 ||
+        std::fread(dst + written, 1, static_cast<size_t>(lengths[i]), fp) !=
+            static_cast<size_t>(lengths[i])) {
+      std::fclose(fp);
+      return -1;
+    }
+    written += lengths[i];
+  }
+  std::fclose(fp);
+  return 0;
+}
+
+// 2-bit quantization with error feedback (gradient_compression-inl.h:40).
+// grad[n], residual[n] (updated in place), out[ceil(n/16)] packed words.
+void mxio_quantize_2bit(const float* grad, float* residual, uint32_t* out,
+                        long n, float threshold) {
+  const long nwords = (n + 15) / 16;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (long w = 0; w < nwords; ++w) {
+    uint32_t word = 0;
+    const long start = w * 16;
+    const long end = start + 16 < n ? start + 16 : n;
+    for (long i = start; i < end; ++i) {
+      float r = residual[i] + grad[i];
+      const int shift = 30 - 2 * static_cast<int>(i - start);
+      if (r >= threshold) {
+        word |= 3u << shift;
+        r -= threshold;
+      } else if (r <= -threshold) {
+        word |= 2u << shift;
+        r += threshold;
+      }
+      residual[i] = r;
+    }
+    out[w] = word;
+  }
+}
+
+// Inverse: packed words -> {-threshold, 0, +threshold} floats.
+void mxio_dequantize_2bit(const uint32_t* in, float* out, long n,
+                          float threshold) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (long i = 0; i < n; ++i) {
+    const uint32_t word = in[i / 16];
+    const int shift = 30 - 2 * static_cast<int>(i % 16);
+    const uint32_t code = (word >> shift) & 3u;
+    out[i] = code == 3u ? threshold : (code == 2u ? -threshold : 0.0f);
+  }
+}
+
+// CHW float conversion + normalization of an interleaved HWC uint8 image —
+// the inner loop of batch assembly (image_aug_default.cc role).
+void mxio_hwc_u8_to_chw_f32(const unsigned char* src, float* dst, long h,
+                            long w, long c, const float* mean,
+                            const float* stdinv) {
+  for (long ch = 0; ch < c; ++ch) {
+    const float m = mean ? mean[ch] : 0.0f;
+    const float s = stdinv ? stdinv[ch] : 1.0f;
+    float* plane = dst + ch * h * w;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+    for (long i = 0; i < h * w; ++i) {
+      plane[i] = (static_cast<float>(src[i * c + ch]) - m) * s;
+    }
+  }
+}
+
+}  // extern "C"

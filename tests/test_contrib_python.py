@@ -162,3 +162,27 @@ def test_image_det_iter_reshape():
         b = it.next()
         assert b.data[0].shape == (1, 3, 8, 8)
         assert b.label[0].shape == (1, 4, 5)
+
+
+def test_image_det_iter_from_rec(tmp_path):
+    """Detection labels measured from .rec records (no imglist)."""
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(3)
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(5):
+        arr = rng.randint(0, 255, (24, 24, 3), np.uint8)
+        nobj = 1 + i % 3
+        label = [4.0, 5.0, 0.0, 0.0]
+        for j in range(nobj):
+            label += [float(j), 0.1, 0.1, 0.5, 0.6]
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, label, i, 0), _png_bytes(arr)))
+    rec.close()
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                               path_imgrec=rec_path)
+    assert it.max_objects == 3
+    batch = it.next()
+    assert batch.label[0].shape == (2, 3, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[0, 0] != -1).any()

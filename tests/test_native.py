@@ -1,0 +1,141 @@
+"""Native C++ runtime library tests (src/runtime_native.cc via ctypes).
+
+Every native kernel is checked against its pure-python fallback — the
+backend-parity discipline of SURVEY.md §4 applied to the host runtime.
+"""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, recordio
+from mxnet_tpu import kvstore as kvs
+
+pytestmark = pytest.mark.skipif(_native.lib() is None,
+                                reason="no native toolchain")
+
+
+def _write_rec(path, payloads):
+    rec = recordio.MXRecordIO(str(path), "w")
+    for p in payloads:
+        rec.write(p)
+    rec.close()
+
+
+def test_scan_records_matches_python(tmp_path):
+    payloads = [bytes([i]) * (5 + 7 * i) for i in range(10)]
+    f = tmp_path / "a.rec"
+    _write_rec(f, payloads)
+    offs, lens = _native.scan_records(str(f))
+    assert list(lens) == [len(p) for p in payloads]
+    # python fallback agrees
+    os.environ["MXNET_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        import importlib
+        # direct python walk (scan_record_positions falls through when
+        # native is disabled in a fresh process; here compare via struct)
+        poffs, plens = [], []
+        with open(f, "rb") as fp:
+            while True:
+                pos = fp.tell()
+                hdr = fp.read(8)
+                if len(hdr) < 8:
+                    break
+                magic, lrec = struct.unpack("<II", hdr)
+                assert magic == 0xced7230a
+                n = lrec & ((1 << 29) - 1)
+                poffs.append(pos + 8)
+                plens.append(n)
+                fp.seek((n + 3) & ~3, 1)
+        assert list(offs) == poffs and list(lens) == plens
+    finally:
+        os.environ.pop("MXNET_TPU_DISABLE_NATIVE", None)
+
+
+def test_read_records(tmp_path):
+    payloads = [b"hello", b"world!!", b"x" * 100]
+    f = tmp_path / "b.rec"
+    _write_rec(f, payloads)
+    offs, lens = _native.scan_records(str(f))
+    got = _native.read_records(str(f), offs, lens)
+    assert got == payloads
+    # gather a subset out of order
+    got2 = _native.read_records(str(f), offs[[2, 0]], lens[[2, 0]])
+    assert got2 == [payloads[2], payloads[0]]
+
+
+def test_scan_corrupt_raises(tmp_path):
+    f = tmp_path / "bad.rec"
+    f.write_bytes(b"\x00" * 32)
+    with pytest.raises(IOError):
+        _native.scan_records(str(f))
+
+
+def test_indexed_recordio_without_idx(tmp_path):
+    """MXIndexedRecordIO builds its seek table by scanning when no .idx."""
+    payloads = [b"rec%d" % i for i in range(6)]
+    f = tmp_path / "c.rec"
+    _write_rec(f, payloads)
+    rio = recordio.MXIndexedRecordIO(None, str(f), "r")
+    assert rio.keys == list(range(6))
+    assert rio.read_idx(4) == payloads[4]
+    assert rio.read_idx(0) == payloads[0]
+
+
+def test_native_2bit_matches_python():
+    rng = np.random.RandomState(0)
+    arr = rng.normal(0, 1, 999).astype(np.float32)
+    res = rng.normal(0, 0.2, 999).astype(np.float32)
+    thr = 0.5
+    p_native, r_native = kvs.quantize_2bit(arr, res.copy(), thr)
+    # force the numpy path
+    os.environ["MXNET_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        code = (
+            "import numpy as np, os\n"
+            "os.environ['JAX_PLATFORMS']='cpu'\n"
+            "from mxnet_tpu import kvstore as kvs\n"
+            "import sys\n"
+            "arr = np.load(sys.argv[1])['arr']\n"
+            "res = np.load(sys.argv[1])['res']\n"
+            "p, r = kvs.quantize_2bit(arr, res, 0.5)\n"
+            "d = kvs.dequantize_2bit(p, arr.size, 0.5)\n"
+            "np.savez(sys.argv[2], p=p.view(np.uint32), r=r, d=d)\n"
+        )
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            inp = os.path.join(td, "in.npz")
+            outp = os.path.join(td, "out.npz")
+            np.savez(inp, arr=arr, res=res)
+            env = dict(os.environ,
+                       PYTHONPATH=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+            subprocess.run([sys.executable, "-c", code, inp, outp],
+                           check=True, env=env, timeout=240)
+            ref = np.load(outp)
+            np.testing.assert_array_equal(p_native.view(np.uint32), ref["p"])
+            np.testing.assert_allclose(r_native.ravel(), ref["r"].ravel(),
+                                       rtol=1e-6)
+            d_native = kvs.dequantize_2bit(p_native, arr.size, thr)
+            np.testing.assert_array_equal(d_native, ref["d"])
+    finally:
+        os.environ.pop("MXNET_TPU_DISABLE_NATIVE", None)
+
+
+def test_hwc_to_chw():
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 255, (7, 9, 3), np.uint8)
+    mean = np.array([10.0, 20.0, 30.0], np.float32)
+    std = np.array([2.0, 4.0, 8.0], np.float32)
+    out = _native.hwc_u8_to_chw_f32(img, mean, std)
+    want = (img.astype(np.float32) - mean) / std
+    np.testing.assert_allclose(out, np.transpose(want, (2, 0, 1)),
+                               rtol=1e-6)
+    plain = _native.hwc_u8_to_chw_f32(img)
+    np.testing.assert_allclose(plain,
+                               np.transpose(img.astype(np.float32),
+                                            (2, 0, 1)))
