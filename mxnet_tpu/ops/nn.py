@@ -98,12 +98,14 @@ def _conv_attrs(attrs, nspatial):
 def _conv(attrs, octx, data, weight, bias=None):
     ns = len(attrs["kernel"])
     k, stride, dilate, pad = _conv_attrs(attrs, ns)
+    # NOTE: no preferred_element_type=f32 for bf16 inputs — the MXU already
+    # accumulates in fp32 internally, and a widened output dtype breaks the
+    # conv transpose rule under reverse-mode (f32 cotangent x bf16 weight)
     y = jax.lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=_CONV_SPECS[ns],
-        feature_group_count=attrs["num_group"],
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+        feature_group_count=attrs["num_group"])
     if y.dtype != data.dtype:
         y = y.astype(data.dtype)
     if not attrs["no_bias"]:

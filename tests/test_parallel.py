@@ -218,3 +218,32 @@ def test_entry_hook_compiles():
     out = jax.jit(fn)(*example_args)
     assert out.shape == (32, 1000)  # flagship: ResNet-50 inference b32
     np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-3)
+
+
+def test_dp_trainer_bf16_multiprecision():
+    """bf16 compute with fp32 master params converges like fp32
+    (reference multi_precision role, optimizer.py:201)."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import data_parallel_mesh, DataParallelTrainer
+
+    sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=4, name="fc"), name="softmax")
+    mesh = data_parallel_mesh(4, jax.devices()[:4])
+    tr = DataParallelTrainer(sym, mesh, optimizer="sgd", learning_rate=0.1,
+                             momentum=0.9, dtype="bfloat16",
+                             rescale_grad=1.0 / 16)
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 8)).astype(np.float32)
+    y = (x @ w.T).argmax(1).astype(np.float32)
+    params, states, aux = tr.init_state({"data": (16, 8),
+                                         "softmax_label": (16,)})
+    inputs = tr.shard_inputs([x, y])
+    for _ in range(40):
+        params, states, aux, loss, outs = tr.step(params, states, aux,
+                                                  inputs)
+    assert str(params[0].dtype) == "float32"      # fp32 masters
+    acc = (np.asarray(outs[0]).argmax(1) == y).mean()
+    assert acc >= 0.9
