@@ -83,7 +83,7 @@ def main():
     # -- training: bf16 multi-precision is the flagship lane (fp32 master
     # params, bf16 compute — the reference trains its fp16 configs the same
     # way, SURVEY §7); fp32 reported alongside ---------------------------------
-    fp32_ips, *_ = _train_ips(sym, mesh, "float32")
+    fp32_ips = _train_ips(sym, mesh, "float32")[0]   # drop fp32 buffers
     bf16_ips, trainer, params, aux, x, y = _train_ips(sym, mesh, "bfloat16")
     train_ips = bf16_ips
     mfu = train_ips * TRAIN_FLOPS_PER_IMG / V5E_PEAK_FLOPS

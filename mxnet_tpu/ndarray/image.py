@@ -1,0 +1,18 @@
+"""mx.nd.image namespace — `_image_*` registry ops exposed without the
+prefix (reference: python/mxnet/ndarray/image autogeneration)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+
+
+def __getattr__(name):
+    from . import __getattr__ as _nd_getattr
+    full = "_image_" + name
+    if full in _registry._REGISTRY:
+        fn = _nd_getattr(full)
+        setattr(_sys.modules[__name__], name, fn)
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.ndarray.image' has no "
+                         f"attribute {name!r}")

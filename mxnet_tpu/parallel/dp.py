@@ -119,8 +119,11 @@ class DataParallelTrainer:
                     args[p] = jnp.asarray(v, jnp.bfloat16) \
                         if compute_bf16 else v
                 for p, v, cast in zip(input_pos, inputs, cast_input):
+                    # only FLOAT inputs cast: integer data (embedding token
+                    # ids) would be corrupted by bf16's 8-bit mantissa
                     args[p] = jnp.asarray(v, jnp.bfloat16) \
-                        if compute_bf16 and cast else v
+                        if compute_bf16 and cast and \
+                        jnp.issubdtype(v.dtype, jnp.floating) else v
                 # aux (BN running stats) stays fp32: _batch_norm casts at
                 # use sites, and the EMA update must accumulate in fp32 —
                 # a bf16 round-trip would quantize the running stats

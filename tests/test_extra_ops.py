@@ -1,0 +1,111 @@
+"""Long-tail operator tests (ops/extra.py — named registry gaps)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+nd = mx.nd
+
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    lab = np.array([1, 0, 3, 2], np.float32)
+    got = float(nd.softmax_cross_entropy(nd.array(x),
+                                         nd.array(lab)).asnumpy())
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    want = -np.log(p[np.arange(4), lab.astype(int)]).sum()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linalg_gelqf():
+    rng = np.random.RandomState(1)
+    a = rng.normal(size=(3, 5)).astype(np.float32)
+    L, Q = nd.linalg_gelqf(nd.array(a))
+    Ln, Qn = L.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(Ln @ Qn, a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(Qn @ Qn.T, np.eye(3), atol=1e-5)
+    assert (np.diag(Ln) >= 0).all()
+    # L lower triangular
+    np.testing.assert_allclose(np.triu(Ln, 1), 0, atol=1e-6)
+
+
+def test_linalg_syevd():
+    rng = np.random.RandomState(2)
+    s = rng.normal(size=(4, 4)).astype(np.float32)
+    s = (s + s.T) / 2
+    U, lam = nd.linalg_syevd(nd.array(s))
+    Un, ln = U.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(Un.T @ np.diag(ln) @ Un, s, atol=1e-4)
+    assert (np.diff(ln) >= -1e-5).all()   # ascending eigenvalues
+
+
+def test_image_ops():
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 255, (5, 6, 3)).astype(np.uint8)
+    t = nd.image.to_tensor(nd.array(img, dtype="uint8"))
+    assert t.shape == (3, 5, 6)
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    norm = nd.image.normalize(t, mean=(0.5, 0.4, 0.3), std=(0.2, 0.2, 0.2))
+    want = (img.transpose(2, 0, 1) / 255.0 -
+            np.array([0.5, 0.4, 0.3])[:, None, None]) / 0.2
+    np.testing.assert_allclose(norm.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_slice_assign_ops():
+    out = nd._slice_assign(nd.zeros((4, 4)), nd.ones((2, 2)),
+                           begin=(1, 1), end=(3, 3)).asnumpy()
+    want = np.zeros((4, 4))
+    want[1:3, 1:3] = 1
+    np.testing.assert_array_equal(out, want)
+    out2 = nd._slice_assign_scalar(nd.zeros((3, 3)), begin=(0, 0),
+                                   end=(2, 2), scalar=5.0).asnumpy()
+    assert out2[:2, :2].sum() == 20 and out2[2].sum() == 0
+    idx = nd.array(np.array([[0, 2]], np.float32))
+    out3 = nd._scatter_set_nd(nd.zeros((3,)), nd.array([7.0, 8.0]), idx,
+                              shape=(3,)).asnumpy()
+    np.testing.assert_array_equal(out3, [7, 0, 8])
+
+
+def test_sparse_tail_ops():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kept = nd._sparse_retain(x, nd.array([1.0, 3.0])).asnumpy()
+    assert kept[0].sum() == 0 and kept[2].sum() == 0
+    np.testing.assert_array_equal(kept[1], x.asnumpy()[1])
+    assert nd.cast_storage(x, stype="csr").shape == x.shape
+    w = nd.ones((3, 2))
+    h = nd.zeros((3, 2))
+    w2 = nd._sparse_adagrad_update(w, nd.ones((3, 2)), h, lr=0.1)
+    np.testing.assert_allclose(w2.asnumpy(), 1 - 0.1 / (1 + 1e-7),
+                               rtol=1e-5)
+    np.testing.assert_allclose(h.asnumpy(), 1.0)  # history mutated
+
+
+def test_identity_kl_sparse_reg_grad():
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.uniform(0.2, 0.8, (6, 3)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                         penalty=0.05)
+        loss = nd.sum(y)
+    loss.backward()
+    g = x.grad.asnumpy()
+    rho = x.asnumpy().mean(0)
+    want = 1.0 + 0.05 * (-0.2 / rho + 0.8 / (1 - rho)) / 6
+    np.testing.assert_allclose(g, np.broadcast_to(want, g.shape), rtol=1e-4)
+
+
+def test_legacy_aliases():
+    out = nd.Convolution_v1(nd.ones((1, 1, 4, 4)), nd.ones((2, 1, 3, 3)),
+                            nd.zeros((2,)), kernel=(3, 3), num_filter=2)
+    assert out.shape == (1, 2, 2, 2)
+    p = nd.Pooling_v1(nd.ones((1, 1, 4, 4)), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    assert p.shape == (1, 1, 2, 2)
+    assert nd._CrossDeviceCopy(nd.ones((2,))).asnumpy().sum() == 2
+    sym = mx.sym.Convolution_v1(mx.sym.Variable("d"), kernel=(3, 3),
+                                num_filter=2, name="c")
+    assert "c_weight" in sym.list_arguments()

@@ -80,3 +80,66 @@ def test_rng_ops_run_on_tpu():
     assert x.context.device_type in ("tpu", "gpu")
     m = float(x.asnumpy().mean())
     assert 0.4 < m < 0.6
+
+
+def test_detection_ops_consistency():
+    """Contrib detection ops agree across backends (fori-loop NMS and
+    argsort compaction must not diverge between CPU and TPU lowering)."""
+    d = mx.sym.Variable("data")
+    anchors = mx.sym.contrib.MultiBoxPrior(d, sizes=(0.3, 0.5),
+                                           ratios=(1.0, 2.0), clip=True)
+    check_consistency(anchors, _pair({"data": (1, 3, 4, 4)}),
+                      rtol=1e-5, atol=1e-6, grad_req="null")
+
+    rng = np.random.RandomState(5)
+    rows = np.concatenate([
+        rng.randint(0, 2, (12, 1)).astype(np.float32),
+        rng.uniform(0.1, 1.0, (12, 1)).astype(np.float32),
+        rng.uniform(0, 0.8, (12, 2)).astype(np.float32),
+        rng.uniform(0.1, 0.3, (12, 2)).astype(np.float32)], axis=1)
+    rows[:, 4:] += rows[:, 2:4]
+    outs = []
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        with mx.Context(ctx):
+            nd_rows = mx.nd.array(rows, ctx=ctx)
+            outs.append(mx.nd.contrib.box_nms(
+                nd_rows, overlap_thresh=0.5, coord_start=2, score_index=1,
+                id_index=0).asnumpy())
+    assert_almost_equal(outs[0], outs[1], rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_ops_consistency():
+    """int8 conv/FC on the MXU lane give the same int32 accumulators as
+    the CPU backend (integer math must be bit-exact)."""
+    rng = np.random.RandomState(6)
+    qx = rng.randint(-127, 128, (2, 3, 6, 6)).astype(np.int8)
+    qw = rng.randint(-127, 128, (4, 3, 3, 3)).astype(np.int8)
+    outs = []
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        x = mx.nd.array(qx, ctx=ctx, dtype="int8")
+        w = mx.nd.array(qw, ctx=ctx, dtype="int8")
+        o, _, _ = mx.nd.contrib.quantized_conv(
+            x, w, mx.nd.array([-1.0], ctx=ctx), mx.nd.array([1.0], ctx=ctx),
+            mx.nd.array([-1.0], ctx=ctx), mx.nd.array([1.0], ctx=ctx),
+            kernel=(3, 3), num_filter=4, no_bias=True)
+        outs.append(o.asnumpy())
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_extra_ops_consistency():
+    rng = np.random.RandomState(7)
+    img = rng.randint(0, 255, (5, 6, 3)).astype(np.uint8)
+    outs = []
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        outs.append(mx.nd._image_to_tensor(
+            mx.nd.array(img, ctx=ctx, dtype="uint8")).asnumpy())
+    assert_almost_equal(outs[0], outs[1], rtol=1e-6, atol=1e-6)
+    # CTC loss parity
+    acts = rng.normal(size=(5, 2, 4)).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.float32)
+    louts = []
+    for ctx in (mx.cpu(0), mx.tpu(0)):
+        louts.append(mx.nd.contrib.ctc_loss(
+            mx.nd.array(acts, ctx=ctx),
+            mx.nd.array(labels, ctx=ctx)).asnumpy())
+    assert_almost_equal(louts[0], louts[1], rtol=1e-4, atol=1e-4)
