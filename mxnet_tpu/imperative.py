@@ -127,6 +127,13 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
     n_out = _num_outputs(schema, attrs)
     outputs = [NDArray(r) for r in results[:n_out]]
 
+    # record BEFORE the aux write-back: the tape snapshots input buffers,
+    # and backward replay must see the PRE-mutation aux (an op whose
+    # gradient depends on its aux state — e.g. IdentityAttachKLSparseReg's
+    # EMA — would otherwise replay against a double-updated buffer)
+    if autograd.is_recording():
+        autograd._record(schema, attrs, rng, is_train, inputs, outputs, n_out)
+
     # auxiliary-state write-back (BatchNorm moving stats): emulates the
     # reference's in-place aux mutation by rebinding the aux NDArray's buffer
     if schema.mutates_aux and (is_train or schema.aux_always):
@@ -134,9 +141,6 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
             src = inputs[aux_i]
             if isinstance(src, NDArray):
                 src._rebind(results[n_out + j])
-
-    if autograd.is_recording():
-        autograd._record(schema, attrs, rng, is_train, inputs, outputs, n_out)
 
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]

@@ -8,5 +8,6 @@ from . import rnn_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import quantization  # noqa: F401
 from . import extra  # noqa: F401
+from . import attention  # noqa: F401
 
 from .registry import get_op, list_ops  # noqa: F401

@@ -1,0 +1,192 @@
+"""Attention operators — Pallas flash-attention kernel + XLA fallback.
+
+The reference has no attention op (its transformer support is the helper
+`_contrib_div_sqrt_dim`, src/operator/contrib/transformer.cc:34); this is
+TPU-first new surface: a blockwise online-softmax kernel written in Pallas
+(per /opt/skills/guides/pallas_guide.md) that keeps the (S, S) score
+matrix out of HBM, gridded over (batch*heads, q-blocks) with the K/V
+stream resident in VMEM. Dispatch picks the kernel on TPU for
+tile-friendly shapes and falls back to a fused XLA implementation
+elsewhere (including the CPU test mesh). The sequence-parallel versions
+live in mxnet_tpu.parallel.sp.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Param, register
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _t(*o):
+    return tuple(o)
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
+                  scale):
+    """One (bh, q-block) grid cell: stream K/V blocks with online softmax."""
+    import jax.experimental.pallas as pl
+
+    q_block = q_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale            # (Bq, D)
+    q_start = pl.program_id(1) * q_block
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, 1), 0)
+
+    acc0 = jnp.zeros((q_block, q.shape[1]), jnp.float32)
+    m0 = jnp.full((q_block, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((q_block, 1), jnp.float32)
+    n_blocks = seq_len // block_k
+    if causal:
+        # flash-attention causal skip: blocks fully above the diagonal
+        # contribute nothing — bound the scan at the q-block's last row
+        n_blocks = jnp.minimum(
+            n_blocks, (q_start + q_block + block_k - 1) // block_k)
+
+    def body(i, carry):
+        acc, m, l = carry
+        start = i * block_k
+        k_blk = k_ref[pl.dslice(start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(start, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                  # (Bq, Bk)
+        if causal:
+            k_pos = start + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + p @ v_blk
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    l = jnp.where(l == 0, 1.0, l)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, causal, scale, interpret=False):
+    """q/k/v (B, H, S, D) with S % block == 0 and D % 128 == 0."""
+    import jax.experimental.pallas as pl
+
+    b, h, s, d = q.shape
+    block_q = min(_BLOCK_Q, s)
+    block_k = min(_BLOCK_K, s)
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=s,
+                               causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi:
+                               (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def _pallas_eligible(q, k):
+    b, h, s, d = q.shape
+    if k.shape != q.shape:
+        return False          # cross-attention: XLA path handles s_q != s_k
+    if d % 128 != 0 and d not in (64,):
+        return False
+    if s % min(_BLOCK_Q, s) != 0 or s % min(_BLOCK_K, s) != 0:
+        return False
+    if s < 8:
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):
+    """Pallas forward + XLA-derived backward: the blockwise kernel has no
+    hand-written transpose, so the vjp recomputes through the dense XLA
+    formulation (identical math) — forward inference gets the kernel,
+    training pays one dense backward."""
+
+    @jax.custom_vjp
+    def fn(q, k, v):
+        return _flash_pallas(q, k, v, causal, scale, interpret=interpret)
+
+    def fwd(q, k, v):
+        return fn(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda a, b, c: reference_attention(a, b, c, causal, scale),
+            q, k, v)
+        return vjp(g)
+
+    fn.defvjp(fwd, bwd)
+    return fn(q, k, v)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, force=None):
+    """Blockwise attention: Pallas kernel on TPU, fused XLA otherwise.
+
+    force: None (auto) | 'pallas' | 'xla' | 'interpret' (kernel under the
+    Pallas interpreter — CPU-testable).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if force == "xla":
+        return reference_attention(q, k, v, causal, scale)
+    if force == "interpret":
+        return _flash_pallas_trainable(q, k, v, causal, scale,
+                                       interpret=True)
+    if force == "pallas" or (force is None and _pallas_eligible(q, k)):
+        return _flash_pallas_trainable(q, k, v, causal, scale)
+    return reference_attention(q, k, v, causal, scale)
+
+
+# -- registry surface -------------------------------------------------------
+
+def _flash_attention_op(attrs, octx, q, k, v):
+    return _t(flash_attention(q, k, v, causal=attrs["causal"],
+                              scale=attrs["scale"]))
+
+
+register("_contrib_flash_attention", _flash_attention_op,
+         params={"causal": Param("bool", False),
+                 "scale": Param("float", None)},
+         inputs=("query", "key", "value"),
+         infer_shape=lambda attrs, s: (s, [s[0]]))
+
+
+def _div_sqrt_dim_check():
+    # _contrib_div_sqrt_dim (transformer.cc:34) already registered in
+    # ops/tensor.py; this module adds the attention core it feeds.
+    pass

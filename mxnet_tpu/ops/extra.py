@@ -204,6 +204,10 @@ def _sparse_adagrad_update(attrs, octx, weight, grad, history):
     # dense execution of the rowwise-sparse AdaGrad update
     # (optimizer_op.cc _sparse_adagrad_update); grads are dense here so the
     # update touches every row — numerically identical when grads are dense
+    if attrs["wd"] != 0.0:
+        # reference hard-fails too (optimizer_op-inl.h:1747
+        # "sparse adagrad_update does not support wd")
+        raise MXNetError("_sparse_adagrad_update does not support wd != 0")
     lr = attrs["lr"]
     eps = attrs["epsilon"]
     g = grad * attrs["rescale_grad"]
@@ -229,34 +233,48 @@ register("_sparse_adagrad_update", _sparse_adagrad_update,
 # identity forward; backward adds the KL-sparseness penalty gradient
 # ---------------------------------------------------------------------------
 
-def _identity_kl_sparse_reg(attrs, octx, data):
+def _identity_kl_sparse_reg(attrs, octx, data, moving_avg):
+    """Identity forward; backward adds penalty * d/drho KL(s || rho) with
+    rho the MOMENTUM-smoothed batch-mean activation kept in the
+    `moving_avg` aux state — matching identity_attach_KL_sparse_reg-inl.h
+    (EMA aux, per-element addition, no batch-size division)."""
     penalty = attrs["penalty"]
     sparseness = attrs["sparseness_target"]
+    momentum = attrs["momentum"]
+
+    rho = jnp.mean(data, axis=0)
+    new_avg = momentum * moving_avg + (1 - momentum) *         jax.lax.stop_gradient(rho) if octx.is_train else moving_avg
 
     @jax.custom_vjp
-    def fn(x):
+    def fn(x, avg):
         return x
 
-    def fwd(x):
-        return x, x
+    def fwd(x, avg):
+        return x, avg
 
-    def bwd(x, g):
-        # d/drho KL(s || rho) summed over the batch-mean activation rho
-        rho = jnp.mean(x, axis=0, keepdims=True)
-        rho = jnp.clip(rho, 1e-6, 1 - 1e-6)
-        kl_grad = penalty * (-sparseness / rho +
-                             (1 - sparseness) / (1 - rho))
-        return (g + kl_grad / x.shape[0],)
+    def bwd(avg, g):
+        a = jnp.clip(avg, 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (-sparseness / a + (1 - sparseness) / (1 - a))
+        return (g + kl_grad[None, :], jnp.zeros_like(avg))
 
     fn.defvjp(fwd, bwd)
-    return _t(fn(data))
+    return _t(fn(data, new_avg), new_avg)
+
+
+def _kl_reg_infer(attrs, in_shapes):
+    ds = in_shapes[0]
+    in_shapes = list(in_shapes)
+    if ds is not None and in_shapes[1] is None:
+        in_shapes[1] = (ds[-1],)
+    return in_shapes, [ds]
 
 
 register("IdentityAttachKLSparseReg", _identity_kl_sparse_reg,
          params={"sparseness_target": Param("float", 0.1),
                  "penalty": Param("float", 0.001),
                  "momentum": Param("float", 0.9)},
-         inputs=("data",))
+         inputs=("data", "moving_avg"), aux=("moving_avg",),
+         mutates_aux=True, infer_shape=_kl_reg_infer)
 
 
 # ---------------------------------------------------------------------------

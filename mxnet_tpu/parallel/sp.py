@@ -1,0 +1,161 @@
+"""Sequence/context parallelism — ring attention and Ulysses all-to-all.
+
+The reference handles long sequences only by bucketing (SURVEY §5); this
+module is the TPU-native long-context machinery the rebuild treats as
+first-class: shard the SEQUENCE axis across a mesh axis so context length
+scales with chip count.
+
+  - `ring_attention`: each device holds a sequence shard of Q/K/V; K/V
+    blocks rotate around the ring with `jax.lax.ppermute` while a
+    numerically-stable online softmax accumulates — N steps of
+    compute/communication overlap on ICI, never materializing the full
+    (S, S) score matrix (blockwise attention).
+  - `ulysses_attention`: `all_to_all` re-shards sequence->heads, runs
+    dense local attention per head group, and re-shards back — cheaper
+    for many-head models when heads % devices == 0.
+
+Both are pure jax (shard_map + collectives): jit/grad compose, XLA
+schedules the collectives on ICI, and the same code runs on the virtual
+CPU mesh used by the tests and the driver dryrun.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["attention_reference", "ring_attention", "ulysses_attention"]
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Dense scaled-dot-product attention — ONE oracle shared with the
+    flash-attention dispatcher (ops/attention.py)."""
+    from ..ops.attention import reference_attention
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _block_attend(q, k, v, acc, m, l, mask=None, scale=1.0):
+    """One online-softmax accumulation step.
+
+    q (B,H,Sq,D) against a K/V block (B,H,Sk,D); carries
+    acc (unnormalized numerator), m (running max), l (running denom).
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: exp(-inf - -inf) -> treat as 0 contribution
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - safe_m)
+    correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = acc * correction[..., None] + \
+        jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return acc_new, m_new, l_new
+
+
+def _ring_attention_shard(q, k, v, axis_name, causal, scale):
+    """Per-device body under shard_map: Q stays, K/V rotate the ring."""
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q_pos = rank * s_loc + jnp.arange(s_loc)            # global Q rows
+
+    # carries derive from q so shard_map types them as varying over the
+    # mesh axis (fresh constants would be unvarying and fail the scan
+    # carry typecheck)
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    m0 = jnp.full_like(q[..., 0], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
+
+    def body(step, carry):
+        acc, m, l, k_blk, v_blk = carry
+        src = (rank - step) % n                          # owner of this K/V
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = jnp.broadcast_to(mask, (b, h, s_loc, s_loc))
+        else:
+            mask = None
+        acc, m, l = _block_attend(q.astype(jnp.float32),
+                                  k_blk.astype(jnp.float32),
+                                  v_blk.astype(jnp.float32),
+                                  acc, m, l, mask, scale)
+        # rotate: receive the next lower rank's block (ship while computing)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return acc, m, l, k_blk, v_blk
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    l = jnp.where(l == 0, 1.0, l)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+    """Sequence-sharded attention over `mesh[axis_name]`.
+
+    q/k/v: (B, H, S, D) with S divisible by the axis size; returns the
+    attention output with the same sharding. Context length scales
+    linearly with devices; peak memory per device is O(S_local^2) scores
+    per block pair instead of O(S^2).
+    """
+    nsp = mesh.shape[axis_name]
+    if q.shape[2] % nsp != 0:
+        raise MXNetError(
+            f"ring_attention: sequence {q.shape[2]} not divisible by "
+            f"{axis_name}={nsp}")
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_shard, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_shard(q, k, v, axis_name, causal, scale, n):
+    # local (B, H, S/n, D) -> all_to_all -> (B, H/n, S, D)
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                      scale=None):
+    """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps the
+    sharded axis from sequence to heads, local attention sees the FULL
+    sequence for its head group, and a second all-to-all restores
+    sequence sharding. Requires heads % axis_size == 0."""
+    nsp = mesh.shape[axis_name]
+    if q.shape[1] % nsp != 0:
+        raise MXNetError(
+            f"ulysses_attention: heads {q.shape[1]} not divisible by "
+            f"{axis_name}={nsp}")
+    if q.shape[2] % nsp != 0:
+        raise MXNetError(
+            f"ulysses_attention: sequence {q.shape[2]} not divisible by "
+            f"{axis_name}={nsp}")
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_shard, axis_name=axis_name,
+                          causal=causal, scale=scale, n=nsp),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -85,17 +85,29 @@ def test_sparse_tail_ops():
 
 def test_identity_kl_sparse_reg_grad():
     rng = np.random.RandomState(4)
-    x = nd.array(rng.uniform(0.2, 0.8, (6, 3)).astype(np.float32))
+    xv = rng.uniform(0.2, 0.8, (6, 3)).astype(np.float32)
+    x = nd.array(xv)
     x.attach_grad()
+    avg = nd.array(np.full(3, 0.5, np.float32))
     with autograd.record():
-        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
-                                         penalty=0.05)
+        y = nd.IdentityAttachKLSparseReg(x, avg, sparseness_target=0.2,
+                                         penalty=0.05, momentum=0.9)
         loss = nd.sum(y)
     loss.backward()
     g = x.grad.asnumpy()
-    rho = x.asnumpy().mean(0)
-    want = 1.0 + 0.05 * (-0.2 / rho + 0.8 / (1 - rho)) / 6
+    # EMA aux updated in train mode; penalty computed from the NEW average,
+    # added per element with no batch-size division (reference -inl.h)
+    new_avg = 0.9 * 0.5 + 0.1 * xv.mean(0)
+    np.testing.assert_allclose(avg.asnumpy(), new_avg, rtol=1e-5)
+    want = 1.0 + 0.05 * (-0.2 / new_avg + 0.8 / (1 - new_avg))
     np.testing.assert_allclose(g, np.broadcast_to(want, g.shape), rtol=1e-4)
+
+
+def test_sparse_adagrad_rejects_wd():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        nd._sparse_adagrad_update(nd.ones((2, 2)), nd.ones((2, 2)),
+                                  nd.zeros((2, 2)), lr=0.1, wd=1e-4)
 
 
 def test_legacy_aliases():
