@@ -1,7 +1,9 @@
 """gluon.nn — neural network layers."""
 from .basic_layers import *
 from .conv_layers import *
+from .transformer import *
 from . import basic_layers
 from . import conv_layers
+from . import transformer
 
-__all__ = basic_layers.__all__ + conv_layers.__all__
+__all__ = basic_layers.__all__ + conv_layers.__all__ + transformer.__all__

@@ -185,8 +185,3 @@ register("_contrib_flash_attention", _flash_attention_op,
          inputs=("query", "key", "value"),
          infer_shape=lambda attrs, s: (s, [s[0]]))
 
-
-def _div_sqrt_dim_check():
-    # _contrib_div_sqrt_dim (transformer.cc:34) already registered in
-    # ops/tensor.py; this module adds the attention core it feeds.
-    pass

@@ -1041,3 +1041,28 @@ def _contrib_div_sqrt_dim(attrs, octx, x):
     return _t(x / jnp.sqrt(jnp.asarray(x.shape[-1], dtype=x.dtype)))
 
 register("_contrib_div_sqrt_dim", _contrib_div_sqrt_dim)
+
+
+def _cumsum(attrs, octx, x):
+    axis = attrs["axis"]
+    dtype = attrs["dtype"]
+    if axis is None:
+        return _t(jnp.cumsum(x.ravel(), dtype=dtype))
+    return _t(jnp.cumsum(x, axis=axis, dtype=dtype))
+
+
+def _cumsum_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None]
+    if attrs["axis"] is None:
+        n = 1
+        for d in s:
+            n *= d
+        return in_shapes, [(n,)]
+    return in_shapes, [tuple(s)]
+
+
+register("cumsum", _cumsum, params={"axis": Param("int", None),
+                                    "dtype": Param("dtype", None)},
+         infer_shape=_cumsum_infer, aliases=("_np_cumsum",))

@@ -14,7 +14,15 @@ from .mesh import (build_mesh, data_parallel_mesh, mesh_for_contexts,
                    mesh_for_devices, replicated_sharding, batch_sharding,
                    put_replicated, put_batch_sharded)
 from .dp import DataParallelTrainer
+from . import sp
+from . import tp
+from . import pp
+from .sp import ring_attention, ulysses_attention
+from .tp import megatron_mlp, moe_ffn
+from .pp import pipeline_mlp
 
 __all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer",
            "mesh_for_contexts", "mesh_for_devices", "replicated_sharding",
-           "batch_sharding", "put_replicated", "put_batch_sharded"]
+           "batch_sharding", "put_replicated", "put_batch_sharded",
+           "sp", "tp", "pp", "ring_attention", "ulysses_attention",
+           "megatron_mlp", "moe_ffn", "pipeline_mlp"]

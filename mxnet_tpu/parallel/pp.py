@@ -1,0 +1,89 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+Absent from the reference (SURVEY §2.3 lists no PP machinery; its closest
+artifact is group2ctx layer placement). TPU-native: each device on the
+`pp` axis owns ONE stage's weights; activations flow stage-to-stage with
+`jax.lax.ppermute` while microbatches stream in, so after the (n_stages-1)
+-tick fill the pipe computes every stage in parallel. Forward-only
+schedule (GPipe fill/drain); gradients come from autodiff through the
+loop, which replays the same communication pattern in reverse.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_mlp", "pipeline_reference"]
+
+
+def pipeline_reference(x_micro, w_stack, b_stack):
+    """Oracle: run every microbatch through all stages sequentially.
+    x_micro (M, B, D); w_stack (S, D, D); b_stack (S, D)."""
+    def run_one(x):
+        for s in range(w_stack.shape[0]):
+            x = jax.nn.relu(x @ w_stack[s] + b_stack[s])
+        return x
+    return jax.vmap(run_one)(x_micro)
+
+
+def _pipe_shard(x_micro, w, b, axis_name, n_micro):
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    w = w[0]        # this device's stage weights (leading shard dim of 1)
+    b = b[0]
+    bsz, d = x_micro.shape[1], x_micro.shape[2]
+    ticks = n_micro + n - 1
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]     # stage s -> s+1
+
+    # pvary marks the carries as device-varying so the fori_loop carry
+    # typecheck accepts the (rank-dependent) tick outputs
+    y0 = jax.lax.pvary(jnp.zeros((bsz, d), x_micro.dtype), (axis_name,))
+    outs0 = jax.lax.pvary(jnp.zeros((n_micro, bsz, d), x_micro.dtype),
+                          (axis_name,))
+
+    def tick(t, carry):
+        y_prev, outs = carry
+        # ship the previous tick's activation down the pipe
+        shifted = jax.lax.ppermute(y_prev, axis_name, fwd_perm)
+        # stage 0 injects microbatch t (zeros once the stream is drained)
+        micro_t = x_micro[jnp.clip(t, 0, n_micro - 1)]
+        micro_t = jnp.where(t < n_micro, micro_t, jnp.zeros_like(micro_t))
+        inj = jnp.where(rank == 0, micro_t, shifted)
+        y = jax.nn.relu(inj @ w + b)
+        # the last stage retires microbatch t-(n-1)
+        out_idx = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        retire = (t >= n - 1) & (rank == n - 1)
+        upd = jnp.where(retire, y, outs[out_idx])
+        outs = jax.lax.dynamic_update_index_in_dim(outs, upd, out_idx, 0)
+        return y, outs
+
+    _, outs = jax.lax.fori_loop(0, ticks, tick, (y0, outs0))
+    # only the last stage holds real outputs: zero elsewhere, psum shares
+    outs = jnp.where(rank == n - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+
+def pipeline_mlp(x_micro, w_stack, b_stack, mesh, axis_name="pp"):
+    """Pipelined stack of relu-Dense stages.
+
+    x_micro (M, B, D) microbatches (replicated); w_stack (S, D, D) /
+    b_stack (S, D) with S == mesh axis size — stage s lives on device s.
+    Returns (M, B, D) replicated outputs.
+    """
+    n = mesh.shape[axis_name]
+    if w_stack.shape[0] != n:
+        raise MXNetError(
+            f"pipeline_mlp: {w_stack.shape[0]} stages but {axis_name} axis "
+            f"has {n} devices (one stage per device)")
+    fn = jax.shard_map(
+        functools.partial(_pipe_shard, axis_name=axis_name,
+                          n_micro=x_micro.shape[0]),
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None, None), P(axis_name, None)),
+        out_specs=P())
+    return fn(x_micro, w_stack, b_stack)

@@ -24,7 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 
@@ -91,10 +91,16 @@ def _ring_attention_shard(q, k, v, axis_name, causal, scale):
                                   k_blk.astype(jnp.float32),
                                   v_blk.astype(jnp.float32),
                                   acc, m, l, mask, scale)
-        # rotate: receive the next lower rank's block (ship while computing)
+        # rotate: receive the next lower rank's block (ship while
+        # computing); the last step's rotation would be discarded — skip it
         perm = [(i, (i + 1) % n) for i in range(n)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+        def rotate(blocks):
+            return tuple(jax.lax.ppermute(x, axis_name, perm)
+                         for x in blocks)
+
+        k_blk, v_blk = jax.lax.cond(step < n - 1, rotate,
+                                    lambda blocks: blocks, (k_blk, v_blk))
         return acc, m, l, k_blk, v_blk
 
     acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
@@ -123,7 +129,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, axis_name, causal, scale, n):
+def _ulysses_shard(q, k, v, axis_name, causal, scale):
     # local (B, H, S/n, D) -> all_to_all -> (B, H/n, S, D)
     def seq_to_heads(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -156,6 +162,6 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_shard, axis_name=axis_name,
-                          causal=causal, scale=scale, n=nsp),
+                          causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

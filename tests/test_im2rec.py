@@ -1,0 +1,57 @@
+"""im2rec tool test: folder -> .lst -> .rec -> ImageIter round trip."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+from PIL import Image
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_im2rec_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    for cls in ("cats", "dogs"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray(
+                rng.randint(0, 255, (20, 24, 3)).astype(np.uint8)
+            ).save(d / f"{cls}{i}.png")
+    prefix = str(tmp_path / "data")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    tool = os.path.join(REPO, "tools", "im2rec.py")
+    r1 = subprocess.run([sys.executable, tool, prefix,
+                         str(tmp_path / "imgs"), "--list", "--recursive"],
+                        env=env, capture_output=True, text=True, timeout=240)
+    assert r1.returncode == 0, r1.stderr
+    lst = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lst) == 6
+    labels = {line.split("\t")[1] for line in lst}
+    assert labels == {"0", "1"}           # two class folders
+
+    r2 = subprocess.run([sys.executable, tool, prefix,
+                         str(tmp_path / "imgs"), "--resize", "16",
+                         "--encoding", ".png"],
+                        env=env, capture_output=True, text=True, timeout=240)
+    assert r2.returncode == 0, r2.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    rio = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rio.keys) == 6
+    header, img = recordio.unpack_img(rio.read_idx(rio.keys[0]))
+    assert min(img.shape[:2]) == 16
+    assert header.label in (0.0, 1.0)
+
+    # feeds ImageIter end to end
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                            path_imgrec=prefix + ".rec",
+                            path_imgidx=prefix + ".idx")
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 16, 16)

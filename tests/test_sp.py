@@ -33,7 +33,7 @@ def test_ring_attention_matches_dense(causal):
     mesh = _mesh(4)
     q, k, v = _qkv()
     want = sp.attention_reference(q, k, v, causal=causal)
-    got = ring = sp.ring_attention(q, k, v, mesh, causal=causal)
+    got = sp.ring_attention(q, k, v, mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
 

@@ -1,0 +1,132 @@
+"""Tensor-parallel MLP + expert-parallel MoE tests (virtual CPU mesh)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import tp
+
+
+def _mesh(n, name):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def test_megatron_mlp_matches_dense():
+    rng = np.random.RandomState(0)
+    b, d, h, dout = 8, 16, 32, 12
+    x = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(d, h)).astype(np.float32)) * 0.3
+    b1 = jnp.asarray(rng.normal(size=(h,)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(h, dout)).astype(np.float32)) * 0.3
+    b2 = jnp.asarray(rng.normal(size=(dout,)).astype(np.float32))
+    want = jax.nn.relu(x @ w1 + b1) @ w2 + b2
+    got = tp.megatron_mlp(x, w1, b1, w2, b2, _mesh(4, "tp"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_mlp_grads():
+    rng = np.random.RandomState(1)
+    mesh = _mesh(4, "tp")
+    x = jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32)) * 0.3
+    b1 = jnp.zeros(16)
+    w2 = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)) * 0.3
+    b2 = jnp.zeros(8)
+
+    def loss_tp(w1, w2):
+        return jnp.sum(tp.megatron_mlp(x, w1, b1, w2, b2, mesh) ** 2)
+
+    def loss_dense(w1, w2):
+        return jnp.sum((jax.nn.relu(x @ w1 + b1) @ w2 + b2) ** 2)
+
+    gt = jax.grad(loss_tp, argnums=(0, 1))(w1, w2)
+    gd = jax.grad(loss_dense, argnums=(0, 1))(w1, w2)
+    for a, b_ in zip(gt, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_validates():
+    mesh = _mesh(4, "tp")
+    with pytest.raises(mx.MXNetError):
+        tp.megatron_mlp(jnp.zeros((2, 4)), jnp.zeros((4, 10)),
+                        jnp.zeros(10), jnp.zeros((10, 4)), jnp.zeros(4),
+                        mesh)
+
+
+def test_moe_ffn_matches_dense():
+    rng = np.random.RandomState(2)
+    b, d, h, e = 16, 8, 12, 8
+    x = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+    gate_w = jnp.asarray(rng.normal(size=(d, e)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(e, d, h)).astype(np.float32)) * 0.3
+    w2 = jnp.asarray(rng.normal(size=(e, h, d)).astype(np.float32)) * 0.3
+    want = tp.moe_ffn_reference(x, gate_w, w1, w2)
+    got = tp.moe_ffn(x, gate_w, w1, w2, _mesh(4, "ep"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_ffn_grads_flow():
+    rng = np.random.RandomState(3)
+    mesh = _mesh(2, "ep")
+    x = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    gate_w = jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(4, 4, 8)).astype(np.float32)) * 0.3
+    w2 = jnp.asarray(rng.normal(size=(4, 8, 4)).astype(np.float32)) * 0.3
+
+    g = jax.grad(lambda w: jnp.sum(
+        tp.moe_ffn(x, gate_w, w, w2, mesh) ** 2))(w1)
+    gd = jax.grad(lambda w: jnp.sum(
+        tp.moe_ffn_reference(x, gate_w, w, w2) ** 2))(w1)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gd), rtol=1e-5,
+                               atol=1e-5)
+    # only routed experts receive gradient
+    routed = set(np.asarray(jnp.argmax(x @ gate_w, axis=1)).tolist())
+    for ei in range(4):
+        has_grad = np.abs(np.asarray(g[ei])).sum() > 0
+        assert has_grad == (ei in routed)
+
+
+def test_pipeline_mlp_matches_sequential():
+    from mxnet_tpu.parallel import pp
+    rng = np.random.RandomState(4)
+    n_stages, n_micro, b, d = 4, 6, 4, 8
+    mesh = _mesh(n_stages, "pp")
+    x = jnp.asarray(rng.normal(size=(n_micro, b, d)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(n_stages, d, d)).astype(np.float32)) \
+        * 0.4
+    bias = jnp.asarray(rng.normal(size=(n_stages, d)).astype(np.float32)) \
+        * 0.1
+    want = pp.pipeline_reference(x, w, bias)
+    got = pp.pipeline_mlp(x, w, bias, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_mlp_grads():
+    from mxnet_tpu.parallel import pp
+    rng = np.random.RandomState(5)
+    mesh = _mesh(2, "pp")
+    x = jnp.asarray(rng.normal(size=(3, 2, 4)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(2, 4, 4)).astype(np.float32)) * 0.4
+    bias = jnp.zeros((2, 4))
+
+    g_pipe = jax.grad(lambda w: jnp.sum(
+        pp.pipeline_mlp(x, w, bias, mesh) ** 2))(w)
+    g_ref = jax.grad(lambda w: jnp.sum(
+        pp.pipeline_reference(x, w, bias) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_validates_stage_count():
+    from mxnet_tpu.parallel import pp
+    mesh = _mesh(4, "pp")
+    with pytest.raises(mx.MXNetError):
+        pp.pipeline_mlp(jnp.zeros((2, 2, 4)), jnp.zeros((3, 4, 4)),
+                        jnp.zeros((3, 4)), mesh)

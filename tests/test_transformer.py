@@ -1,0 +1,83 @@
+"""Transformer model family: blocks + tiny causal LM training."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_multihead_attention_shapes_and_grad():
+    rng = np.random.RandomState(0)
+    mha = gluon.nn.MultiHeadAttention(units=32, num_heads=4, causal=True)
+    mha.initialize()
+    x = mx.nd.array(rng.normal(size=(2, 16, 32)).astype(np.float32))
+    out = mha(x)
+    assert out.shape == (2, 16, 32)
+    with autograd.record():
+        y = mx.nd.sum(mha(x) ** 2)
+    y.backward()
+    g = mha.proj_query.weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_mha_causality():
+    """Causal attention: output at position t is independent of tokens > t."""
+    rng = np.random.RandomState(1)
+    mha = gluon.nn.MultiHeadAttention(units=16, num_heads=2, causal=True)
+    mha.initialize()
+    x1 = rng.normal(size=(1, 8, 16)).astype(np.float32)
+    x2 = x1.copy()
+    x2[0, 5:] += 10.0           # perturb the future
+    o1 = mha(mx.nd.array(x1)).asnumpy()
+    o2 = mha(mx.nd.array(x2)).asnumpy()
+    np.testing.assert_allclose(o1[0, :5], o2[0, :5], rtol=1e-4, atol=1e-5)
+    assert np.abs(o1[0, 5:] - o2[0, 5:]).max() > 1e-3
+
+
+def test_transformer_lm_trains():
+    """Tiny causal LM learns a deterministic next-token pattern."""
+    rng = np.random.RandomState(2)
+    vocab, seq, batch = 12, 16, 8
+    net = gluon.nn.TransformerEncoder(vocab_size=vocab, units=32,
+                                      hidden_size=64, num_heads=4,
+                                      num_layers=2, max_length=seq)
+    head = gluon.nn.Dense(vocab, flatten=False)
+    net.initialize(mx.init.Xavier())
+    head.initialize(mx.init.Xavier())
+    params = {**net.collect_params(), **head.collect_params()}
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # pattern: next token = (token + 3) % vocab
+    tokens = rng.randint(0, vocab, (batch, seq + 1))
+    tokens = np.cumsum(np.full((batch, seq + 1), 3), axis=1) % vocab
+    tokens[:, 0] = rng.randint(0, vocab, batch)
+    tokens = (tokens[:, :1] + np.arange(seq + 1) * 3) % vocab
+    x = mx.nd.array(tokens[:, :-1].astype(np.float32))
+    y = mx.nd.array(tokens[:, 1:].astype(np.float32))
+
+    losses = []
+    for _ in range(60):
+        with autograd.record():
+            feats = net(x)
+            logits = head(feats)
+            loss = loss_fn(logits.reshape(-3, 0), y.reshape(-1)).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.3, losses[-5:]
+    pred = head(net(x)).asnumpy().argmax(-1)
+    acc = (pred == tokens[:, 1:]).mean()
+    assert acc > 0.9, acc
+
+
+def test_transformer_hybridize():
+    net = gluon.nn.TransformerEncoder(vocab_size=10, units=16,
+                                      hidden_size=32, num_heads=2,
+                                      num_layers=1, max_length=8)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.zeros((2, 8), np.float32))
+    out = net(x)
+    assert out.shape == (2, 8, 16)
+    out2 = net(x)   # cached graph path
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy())

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""im2rec — build .lst / .rec(.idx) datasets from an image folder.
+
+Parity surface: tools/im2rec.py (list generation + record packing; the C++
+tools/im2rec.cc is subsumed — encoding runs through cv2/PIL and the
+native recordio writer). Core modes:
+
+  python tools/im2rec.py PREFIX ROOT --list [--recursive] [--train-ratio R]
+  python tools/im2rec.py PREFIX ROOT [--resize N] [--quality Q]
+                                     [--pack-label] [--num-thread T]
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_images(root, exts, recursive):
+    i = 0
+    cat = {}
+    if recursive:
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                fpath = os.path.join(path, fname)
+                if os.path.splitext(fname)[1].lower() in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, rel, label in image_list:
+            fout.write(f"{idx}\t{label}\t{rel}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def make_lists(args):
+    images = list(list_images(args.root, args.exts, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(images)
+    n = len(images)
+    n_train = int(n * args.train_ratio)
+    n_test = int(n * args.test_ratio)
+    sets = []
+    if args.train_ratio < 1.0 or args.test_ratio > 0:
+        if n_test:
+            sets.append(("_test", images[:n_test]))
+        sets.append(("_train", images[n_test:n_test + n_train]))
+        if n_test + n_train < n:
+            sets.append(("_val", images[n_test + n_train:]))
+    else:
+        sets.append(("", images))
+    for suffix, subset in sets:
+        write_list(f"{args.prefix}{suffix}.lst", subset)
+        print(f"wrote {args.prefix}{suffix}.lst ({len(subset)} images)")
+
+
+def _load_and_encode(args, rel, labels, idx):
+    import numpy as np
+    fpath = os.path.join(args.root, rel)
+    if args.pass_through:
+        with open(fpath, "rb") as f:
+            payload = f.read()
+        if len(labels) == 1:
+            header = recordio.IRHeader(0, labels[0], idx, 0)
+        else:
+            header = recordio.IRHeader(len(labels),
+                                       np.asarray(labels, np.float32),
+                                       idx, 0)
+        return recordio.pack(header, payload)
+    from PIL import Image
+    img = Image.open(fpath)
+    if args.color == 1:
+        img = img.convert("RGB")
+    elif args.color == 0:
+        img = img.convert("L")
+    if args.resize:
+        w, h = img.size
+        if min(w, h) != args.resize:
+            scale = args.resize / min(w, h)
+            img = img.resize((max(1, int(w * scale)),
+                              max(1, int(h * scale))))
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+    arr = np.asarray(img)
+    if len(labels) == 1 and not args.pack_label:
+        header = recordio.IRHeader(0, labels[0], idx, 0)
+    else:
+        header = recordio.IRHeader(len(labels),
+                                   np.asarray(labels, np.float32), idx, 0)
+    return recordio.pack_img(header, arr, quality=args.quality,
+                             img_fmt=args.encoding)
+
+
+def make_record(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    entries = list(read_list(lst_path))
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    if args.num_thread > 1:
+        with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
+            packed = list(pool.map(
+                lambda e: _load_and_encode(args, e[1], e[2], e[0]), entries))
+    else:
+        packed = [_load_and_encode(args, rel, labels, idx)
+                  for idx, rel, labels in entries]
+    for (idx, _, _), payload in zip(entries, packed):
+        rec.write_idx(idx, payload)
+    rec.close()
+    print(f"wrote {prefix}.rec ({len(entries)} records)")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Create image lists and recordio databases")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="folder containing images")
+    cg = p.add_argument_group("list creation")
+    cg.add_argument("--list", action="store_true")
+    cg.add_argument("--exts", nargs="+",
+                    default=[".jpeg", ".jpg", ".png"])
+    cg.add_argument("--train-ratio", type=float, default=1.0)
+    cg.add_argument("--test-ratio", type=float, default=0.0)
+    cg.add_argument("--recursive", action="store_true")
+    cg.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rg = p.add_argument_group("record creation")
+    rg.add_argument("--pass-through", action="store_true",
+                    help="skip transcoding: raw file bytes")
+    rg.add_argument("--resize", type=int, default=0)
+    rg.add_argument("--center-crop", action="store_true")
+    rg.add_argument("--quality", type=int, default=95)
+    rg.add_argument("--num-thread", type=int, default=1)
+    rg.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rg.add_argument("--encoding", default=".jpg",
+                    choices=[".jpg", ".png"])
+    rg.add_argument("--pack-label", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list:
+        make_lists(args)
+        return 0
+    # pack every matching .lst for the prefix
+    dirname = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    base = os.path.basename(args.prefix)
+    lsts = [os.path.join(dirname, f) for f in os.listdir(dirname)
+            if f.startswith(base) and f.endswith(".lst")]
+    if not lsts:
+        print(f"no .lst files matching prefix {args.prefix!r}; run with "
+              "--list first", file=sys.stderr)
+        return 1
+    for lst in sorted(lsts):
+        make_record(args, lst)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
