@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Gluon imperative training with a model-zoo ResNet.
+
+Role of example/gluon/image_classification.py: hybridized model-zoo net,
+gluon.Trainer, autograd — on synthetic CIFAR-shaped blobs.
+
+  python examples/gluon_cifar.py [--model resnet18_v1] [--ctx tpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--ctx", default="tpu", choices=("cpu", "tpu"))
+    args = ap.parse_args()
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+
+    net = gluon.model_zoo.vision.get_model(args.model,
+                                           classes=args.classes)
+    net.initialize(mx.init.Xavier(magnitude=2.0), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, args.classes, args.batch)
+    x = rng.normal(0, 0.3, (args.batch, 3, 32, 32)).astype(np.float32)
+    x += y[:, None, None, None] * 0.2          # separable classes
+    xb = mx.nd.array(x, ctx=ctx)
+    yb = mx.nd.array(y.astype(np.float32), ctx=ctx)
+
+    metric = mx.metric.Accuracy()
+    for step in range(args.steps):
+        with autograd.record():
+            out = net(xb)
+            loss = loss_fn(out, yb).mean()
+        loss.backward()
+        trainer.step(1)
+        metric.reset()
+        metric.update([yb], [out])
+        if step % 10 == 9:
+            print(f"step {step + 1}: loss {float(loss.asnumpy()):.3f} "
+                  f"acc {metric.get()[1]:.3f}")
+    return 0 if metric.get()[1] > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

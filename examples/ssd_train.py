@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""SSD detection training (reference example/ssd, scaled down).
+
+Mini-VGG backbone, two anchor scales, MultiBoxTarget assignment with hard
+negative mining, joint softmax + smooth-L1 loss through Module, then
+MultiBoxDetection + box_nms decode on the trained model.
+
+  python examples/ssd_train.py [--steps 40] [--ctx cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def build_ssd(num_classes, num_anchors=3):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+
+    def block(x, nf, name):
+        x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                               name=f"{name}_conv")
+        x = mx.sym.Activation(x, act_type="relu")
+        return mx.sym.Pooling(x, pool_type="max", kernel=(2, 2),
+                              stride=(2, 2))
+
+    f1 = block(block(data, 16, "b1"), 32, "b2")       # /4
+    f2 = block(f1, 32, "b3")                          # /8
+    anchors_list, cls_list, loc_list = [], [], []
+    for i, (feat, sizes) in enumerate([(f1, (0.2, 0.35)),
+                                       (f2, (0.4, 0.6))]):
+        anchors_list.append(mx.sym.contrib.MultiBoxPrior(
+            feat, sizes=sizes, ratios=(1.0, 2.0), clip=True))
+        cp = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                num_filter=(num_classes + 1) * num_anchors,
+                                name=f"clshead{i}")
+        cp = mx.sym.transpose(cp, axes=(0, 2, 3, 1))
+        cls_list.append(mx.sym.reshape(cp, shape=(0, -1, num_classes + 1)))
+        lp = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                num_filter=4 * num_anchors,
+                                name=f"lochead{i}")
+        loc_list.append(mx.sym.Flatten(
+            mx.sym.transpose(lp, axes=(0, 2, 3, 1))))
+    anchors = mx.sym.Concat(*anchors_list, dim=1)
+    cls_pred = mx.sym.transpose(mx.sym.Concat(*cls_list, dim=1),
+                                axes=(0, 2, 1))
+    loc_pred = mx.sym.Concat(*loc_list, dim=1)
+    tgt = mx.sym.contrib.MultiBoxTarget(anchors, label, cls_pred,
+                                        overlap_threshold=0.5,
+                                        negative_mining_ratio=3.0)
+    cls_prob = mx.sym.SoftmaxOutput(cls_pred, tgt[2], multi_output=True,
+                                    use_ignore=True, ignore_label=-1,
+                                    normalization="valid", name="cls_prob")
+    loc_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(tgt[1] * (loc_pred - tgt[0]), scalar=1.0))
+    return mx.sym.Group([cls_prob, loc_loss, mx.sym.BlockGrad(tgt[2]),
+                         mx.sym.BlockGrad(anchors),
+                         mx.sym.BlockGrad(loc_pred)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--classes", type=int, default=3)
+    ap.add_argument("--ctx", default="cpu", choices=("cpu", "tpu"))
+    args = ap.parse_args()
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+
+    rng = np.random.RandomState(0)
+    labels = np.zeros((args.batch, 2, 5), np.float32)
+    labels[:, 1] = -1
+    for i in range(args.batch):
+        x1, y1 = rng.uniform(0.05, 0.45, 2)
+        labels[i, 0] = [i % args.classes, x1, y1,
+                        x1 + rng.uniform(0.2, 0.4),
+                        y1 + rng.uniform(0.2, 0.4)]
+    images = rng.uniform(-1, 1, (args.batch, 3, 32, 32)).astype(np.float32)
+
+    mod = mx.mod.Module(build_ssd(args.classes), data_names=("data",),
+                        label_names=("label",), context=ctx)
+    mod.bind(data_shapes=[("data", images.shape)],
+             label_shapes=[("label", labels.shape)])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / args.batch})
+    batch = mx.io.DataBatch(data=[mx.nd.array(images)],
+                            label=[mx.nd.array(labels)])
+    for step in range(args.steps):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    outs = mod.get_outputs()
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(outs[0].asnumpy()), mx.nd.array(outs[4].asnumpy()),
+        mx.nd.array(outs[3].asnumpy()[:1]), threshold=0.1,
+        nms_threshold=0.45, nms_topk=10).asnumpy()
+    valid = det[det[:, :, 0] >= 0]
+    print(f"{len(valid)} detections after {args.steps} steps; "
+          f"example: {valid[0] if len(valid) else None}")
+    return 0 if len(valid) > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

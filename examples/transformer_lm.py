@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Causal transformer LM on the flash-attention op (TPU-first family).
+
+  python examples/transformer_lm.py [--steps 60] [--ctx cpu]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--vocab", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--ctx", default="cpu", choices=("cpu", "tpu"))
+    args = ap.parse_args()
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+
+    net = gluon.nn.TransformerEncoder(vocab_size=args.vocab, units=32,
+                                      hidden_size=64, num_heads=4,
+                                      num_layers=2, max_length=args.seq)
+    head = gluon.nn.Dense(args.vocab, flatten=False)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    head.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer({**net.collect_params(),
+                             **head.collect_params()},
+                            "adam", {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # task: next token = (token + 3) % vocab
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, args.vocab, (args.batch, 1))
+    tokens = (start + np.arange(args.seq + 1) * 3) % args.vocab
+    x = mx.nd.array(tokens[:, :-1].astype(np.float32), ctx=ctx)
+    y = mx.nd.array(tokens[:, 1:].astype(np.float32), ctx=ctx)
+
+    for i in range(args.steps):
+        with autograd.record():
+            logits = head(net(x))
+            loss = loss_fn(logits.reshape(-3, 0), y.reshape(-1)).mean()
+        loss.backward()
+        trainer.step(1)
+        if i % 20 == 19:
+            print(f"step {i + 1}: loss {float(loss.asnumpy()):.4f}")
+    acc = (head(net(x)).asnumpy().argmax(-1) == tokens[:, 1:]).mean()
+    print(f"next-token accuracy: {acc:.3f}")
+    return 0 if acc > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,7 @@ from . import visualization
 from . import visualization as viz
 from . import config
 from . import operator
+from . import rtc
 config._apply_startup()
 from .monitor import Monitor
 from . import module

@@ -99,15 +99,32 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
     datas = _reconcile_mesh(datas)
     rng = _random.next_key() if schema.needs_rng else None
     from . import profiler, engine
+
+    # 'ops run on their inputs' context': jit does NOT follow committed
+    # inputs on this jax (outputs land on the default device — a cpu-ctx
+    # op would silently migrate to the TPU), so pin the dispatch device to
+    # the first array input's (single) device via default_device.
+    run_dev = None
+    for d in datas:
+        devs = getattr(d, "devices", None)
+        if devs is not None:
+            ds = devs()
+            if len(ds) == 1:
+                run_dev = next(iter(ds))
+            break
+
+    def _call():
+        if run_dev is not None:
+            with jax.default_device(run_dev):
+                return fn(rng, *datas) if schema.needs_rng else fn(*datas)
+        return fn(rng, *datas) if schema.needs_rng else fn(*datas)
+
     if profiler.imperative_enabled():
         # per-op timing synchronizes the op (engine-profiling role,
         # threaded_engine.cc:476)
-        results = profiler.profile_op(
-            schema.name,
-            (lambda: fn(rng, *datas)) if schema.needs_rng
-            else (lambda: fn(*datas)))
+        results = profiler.profile_op(schema.name, _call)
     else:
-        results = fn(rng, *datas) if schema.needs_rng else fn(*datas)
+        results = _call()
     if engine._sync_mode:
         jax.block_until_ready(results)   # NaiveEngine determinism toggle
     if not isinstance(results, tuple):

@@ -43,7 +43,11 @@ def megatron_mlp(x, w1, b1, w2, b2, mesh, axis_name="tp"):
     H must divide by the axis size. Returns (B, D_out) replicated.
     """
     n = mesh.shape[axis_name]
-    if w1.shape[1] % n != 0 or w2.shape[0] % n != 0:
+    if w1.shape[1] != w2.shape[0]:
+        raise MXNetError(
+            f"megatron_mlp: w1 hidden dim {w1.shape[1]} != w2 input dim "
+            f"{w2.shape[0]}")
+    if w1.shape[1] % n != 0:
         raise MXNetError(
             f"megatron_mlp: hidden dim {w1.shape[1]} not divisible by "
             f"{axis_name}={n}")

@@ -49,6 +49,27 @@ def test_im2rec_roundtrip(tmp_path):
     assert min(img.shape[:2]) == 16
     assert header.label in (0.0, 1.0)
 
+    # channel-order round trip: a pure-red image must come back red
+    red_dir = tmp_path / "red"
+    red_dir.mkdir()
+    red = np.zeros((16, 16, 3), np.uint8)
+    red[..., 0] = 250
+    Image.fromarray(red).save(red_dir / "r.png")
+    p2 = str(tmp_path / "red_data")
+    for cmd in (["--list"], ["--encoding", ".png"]):
+        rr = subprocess.run([sys.executable, tool, p2, str(red_dir)] + cmd,
+                            env=env, capture_output=True, text=True,
+                            timeout=240)
+        assert rr.returncode == 0, rr.stderr
+    # the TRAINING reader (mx.image.imdecode, BGR->RGB) must see red in
+    # channel 0; raw unpack_img stays BGR (reference recordio parity)
+    rio2 = recordio.MXIndexedRecordIO(p2 + ".idx", p2 + ".rec", "r")
+    _, payload = recordio.unpack(rio2.read_idx(rio2.keys[0]))
+    decoded = mx.image.imdecode(payload)
+    rarr = decoded.asnumpy() if hasattr(decoded, "asnumpy") \
+        else np.asarray(decoded)
+    assert rarr[..., 0].mean() > 200 and rarr[..., 2].mean() < 50
+
     # feeds ImageIter end to end
     it = mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
                             path_imgrec=prefix + ".rec",

@@ -1,0 +1,41 @@
+"""mx.rtc runtime kernel compilation (Pallas analog of CudaModule)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_cuda_module_informative_error():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_pallas_module_roundtrip():
+    mod = mx.rtc.PallasModule(r"""
+def scale_add(x_ref, y_ref, out_ref):
+    out_ref[:] = x_ref[:] * 2.0 + y_ref[:]
+
+def negate(x_ref, out_ref):
+    out_ref[:] = -x_ref[:]
+""")
+    k = mod.get_kernel("scale_add", num_inputs=2)
+    a = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    b = mx.nd.ones((2, 4))
+    out = k.launch(a, b)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() * 2 + 1)
+    neg = mod.get_kernel("negate", num_inputs=1)
+    np.testing.assert_allclose(neg.launch(a).asnumpy(), -a.asnumpy())
+
+
+def test_pallas_module_errors():
+    with pytest.raises(mx.MXNetError, match="failed to compile"):
+        mx.rtc.PallasModule("def broken(:")
+    mod = mx.rtc.PallasModule("def k(x_ref, o_ref):\n    o_ref[:] = x_ref[:]")
+    with pytest.raises(mx.MXNetError, match="no kernel"):
+        mod.get_kernel("nope")
+    with pytest.raises(mx.MXNetError, match="exports"):
+        mx.rtc.PallasModule("def k(x_ref, o_ref):\n    o_ref[:] = x_ref[:]",
+                            exports=("missing",))
+    kk = mod.get_kernel("k", num_inputs=1)
+    with pytest.raises(mx.MXNetError, match="expects"):
+        kk.launch(mx.nd.ones((2,)), mx.nd.ones((2,)))

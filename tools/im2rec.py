@@ -88,7 +88,7 @@ def _load_and_encode(args, rel, labels, idx):
     if args.pass_through:
         with open(fpath, "rb") as f:
             payload = f.read()
-        if len(labels) == 1:
+        if len(labels) == 1 and not args.pack_label:
             header = recordio.IRHeader(0, labels[0], idx, 0)
         else:
             header = recordio.IRHeader(len(labels),
@@ -113,6 +113,10 @@ def _load_and_encode(args, rel, labels, idx):
         left, top = (w - s) // 2, (h - s) // 2
         img = img.crop((left, top, left + s, top + s))
     arr = np.asarray(img)
+    if arr.ndim == 3:
+        # recordio.pack_img encodes via cv2 (BGR); PIL loaded RGB — flip so
+        # imdecode's BGR->RGB on read restores the original channel order
+        arr = arr[..., ::-1]
     if len(labels) == 1 and not args.pack_label:
         header = recordio.IRHeader(0, labels[0], idx, 0)
     else:
@@ -126,15 +130,16 @@ def make_record(args, lst_path):
     prefix = os.path.splitext(lst_path)[0]
     entries = list(read_list(lst_path))
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    # stream: encoded records are written as they arrive, never all in RAM
     if args.num_thread > 1:
         with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
-            packed = list(pool.map(
-                lambda e: _load_and_encode(args, e[1], e[2], e[0]), entries))
+            packed_iter = pool.map(
+                lambda e: _load_and_encode(args, e[1], e[2], e[0]), entries)
+            for (idx, _, _), payload in zip(entries, packed_iter):
+                rec.write_idx(idx, payload)
     else:
-        packed = [_load_and_encode(args, rel, labels, idx)
-                  for idx, rel, labels in entries]
-    for (idx, _, _), payload in zip(entries, packed):
-        rec.write_idx(idx, payload)
+        for idx, rel, labels in entries:
+            rec.write_idx(idx, _load_and_encode(args, rel, labels, idx))
     rec.close()
     print(f"wrote {prefix}.rec ({len(entries)} records)")
 
