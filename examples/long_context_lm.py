@@ -7,7 +7,7 @@ across the mesh, K/V blocks ride the ICI ring, and context length scales
 with device count.
 
   python examples/long_context_lm.py [--devices 8] [--seq-per-dev 256]
-(runs on a virtual CPU mesh by default; on a pod, drop --force-cpu)
+(virtual CPU mesh by default; on a pod pass --no-force-cpu)
 """
 import argparse
 import os
@@ -23,7 +23,8 @@ def main():
     ap.add_argument("--units", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--steps", type=int, default=5)
-    ap.add_argument("--force-cpu", action="store_true", default=True)
+    ap.add_argument("--force-cpu", default=True,
+                    action=argparse.BooleanOptionalAction)
     args = ap.parse_args()
 
     import jax

@@ -57,7 +57,15 @@ class PallasKernel:
         vals = [a._data if isinstance(a, NDArray) else a for a in arrays]
         if out_shape is None:
             out_shape = jax.ShapeDtypeStruct(vals[0].shape, vals[0].dtype)
-        kwargs = {"out_shape": out_shape, "interpret": self._interpret}
+        # interpret follows the INPUT's device: cpu-resident arrays need
+        # the interpreter even when an accelerator backend exists
+        interpret = self._interpret
+        devs = getattr(vals[0], "devices", None)
+        if devs is not None:
+            ds = devs()
+            if len(ds) == 1:
+                interpret = next(iter(ds)).platform == "cpu"
+        kwargs = {"out_shape": out_shape, "interpret": interpret}
         if grid is not None:
             kwargs["grid"] = grid
         call = pl.pallas_call(self._fn, **kwargs)
@@ -85,8 +93,9 @@ class PallasModule:
         namespace = {"jax": jax, "jnp": jnp, "pl": pl, "pltpu": pltpu}
         try:
             exec(compile(source, "<rtc source>", "exec"), namespace)
-        except SyntaxError as e:
-            raise MXNetError(f"PallasModule: source failed to compile: {e}")
+        except Exception as e:
+            raise MXNetError(
+                f"PallasModule: source failed to compile: {e}") from e
         self._fns = {k: v for k, v in namespace.items()
                      if callable(v) and not k.startswith("_")
                      and k not in ("jax", "jnp", "pl", "pltpu")}

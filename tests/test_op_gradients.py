@@ -444,3 +444,75 @@ def test_negative_binomial_moments():
         shape=(n,)).asnumpy()
     assert z.shape == (2, n)
     np.testing.assert_allclose(z.mean(1), [2.0, 4.0], rtol=0.05)
+
+
+def test_vision_op_gradients():
+    """Numeric-gradient checks for the round-2 differentiable vision ops
+    (reference check_numeric_gradient discipline, test_utils.py:792)."""
+    rng = np.random.RandomState(0)
+    d = mx.sym.Variable("data")
+
+    # BilinearSampler: grads wrt data AND grid
+    grid = mx.sym.Variable("grid")
+    bs = mx.sym.BilinearSampler(d, grid)
+    ys = np.linspace(-0.9, 0.9, 4, dtype=np.float32)
+    xs = np.linspace(-0.9, 0.9, 5, dtype=np.float32)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    g = np.stack([gx, gy])[None] + rng.uniform(-0.02, 0.02,
+                                               (1, 2, 4, 5)).astype(
+        np.float32)
+    check_numeric_gradient(bs, {"data": _any((1, 2, 4, 5)), "grid": g},
+                           rtol=5e-2, atol=5e-3)
+
+    # SpatialTransformer wrt data and loc
+    loc = mx.sym.Variable("loc")
+    st = mx.sym.SpatialTransformer(d, loc, target_shape=(4, 4),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    theta = np.array([[0.9, 0.05, 0.02, -0.03, 0.85, 0.01]], np.float32)
+    check_numeric_gradient(st, {"data": _any((1, 2, 5, 5)), "loc": theta},
+                           rtol=5e-2, atol=5e-3)
+
+    # AdaptiveAvgPooling2D / BilinearResize2D wrt data
+    check_numeric_gradient(
+        mx.sym.contrib.AdaptiveAvgPooling2D(d, output_size=(2, 2)),
+        {"data": _any((1, 2, 5, 5))}, rtol=5e-2, atol=5e-3)
+    check_numeric_gradient(
+        mx.sym.contrib.BilinearResize2D(d, height=6, width=6),
+        {"data": _any((1, 2, 4, 4))}, rtol=5e-2, atol=5e-3)
+
+
+def test_detection_and_signal_gradients():
+    rng = np.random.RandomState(1)
+    d1 = mx.sym.Variable("data1")
+    d2 = mx.sym.Variable("data2")
+
+    # Correlation wrt both inputs
+    corr = mx.sym.Correlation(d1, d2, kernel_size=1, max_displacement=1,
+                              pad_size=1)
+    check_numeric_gradient(corr, {"data1": _any((1, 2, 4, 4)),
+                                  "data2": _any((1, 2, 4, 4))},
+                           rtol=5e-2, atol=5e-3)
+
+    # ROIPooling wrt data (rois held constant)
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    rp = mx.sym.ROIPooling(data, rois, pooled_size=(2, 2),
+                           spatial_scale=1.0)
+    check_numeric_gradient(
+        rp, {"data": _any((1, 2, 6, 6)),
+             "rois": np.array([[0, 0, 0, 5, 5]], np.float32)},
+        grad_nodes=["data"], rtol=5e-2, atol=5e-3)
+
+    # flash attention (XLA path) wrt q/k/v through the registry op
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    fa = mx.sym.contrib.flash_attention(q, k, v, causal=True)
+    qkv = {n: _any((1, 2, 4, 4), seed=i)
+           for i, n in enumerate(("q", "k", "v"))}
+    check_numeric_gradient(fa, qkv, rtol=5e-2, atol=5e-3)
+
+    # fft/ifft linearity gradients
+    check_numeric_gradient(mx.sym.contrib.fft(mx.sym.Variable("data")),
+                           {"data": _any((2, 8))}, rtol=5e-2, atol=5e-3)

@@ -113,9 +113,16 @@ def _load_and_encode(args, rel, labels, idx):
         left, top = (w - s) // 2, (h - s) // 2
         img = img.crop((left, top, left + s, top + s))
     arr = np.asarray(img)
-    if arr.ndim == 3:
+    try:
+        import cv2  # noqa: F401
+        cv2_encoder = True
+    except ImportError:
+        cv2_encoder = False
+    if cv2_encoder and arr.ndim == 3 and arr.shape[-1] == 3:
         # recordio.pack_img encodes via cv2 (BGR); PIL loaded RGB — flip so
-        # imdecode's BGR->RGB on read restores the original channel order
+        # imdecode's BGR->RGB on read restores the original channel order.
+        # PIL-only environments encode RGB verbatim: no flip. RGBA is left
+        # untouched (cv2 BGRA handling differs; --color -1 users keep raw).
         arr = arr[..., ::-1]
     if len(labels) == 1 and not args.pack_label:
         header = recordio.IRHeader(0, labels[0], idx, 0)
@@ -130,13 +137,21 @@ def make_record(args, lst_path):
     prefix = os.path.splitext(lst_path)[0]
     entries = list(read_list(lst_path))
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
-    # stream: encoded records are written as they arrive, never all in RAM
+    # stream with a bounded in-flight window so encoded payloads never
+    # accumulate beyond ~2x the worker count
     if args.num_thread > 1:
+        from collections import deque
         with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
-            packed_iter = pool.map(
-                lambda e: _load_and_encode(args, e[1], e[2], e[0]), entries)
-            for (idx, _, _), payload in zip(entries, packed_iter):
-                rec.write_idx(idx, payload)
+            window = deque()
+            for entry in entries:
+                window.append((entry[0], pool.submit(
+                    _load_and_encode, args, entry[1], entry[2], entry[0])))
+                if len(window) >= 2 * args.num_thread:
+                    idx, fut = window.popleft()
+                    rec.write_idx(idx, fut.result())
+            while window:
+                idx, fut = window.popleft()
+                rec.write_idx(idx, fut.result())
     else:
         for idx, rel, labels in entries:
             rec.write_idx(idx, _load_and_encode(args, rel, labels, idx))
