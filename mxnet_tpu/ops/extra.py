@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
-from .registry import Param, register, get_op, _REGISTRY
+from .registry import Param, register, register_alias, get_op, _REGISTRY
 
 
 def _t(*o):
@@ -303,14 +303,37 @@ register("_identity_with_attr_like_rhs", _identity_with_attr_like_rhs,
 # legacy _v1 / backend-specific names -> modern twins
 # ---------------------------------------------------------------------------
 
-def _register_alias(alias, target):
-    schema = get_op(target)
-    if alias not in _REGISTRY:
-        _REGISTRY[alias] = schema
 
 
-_register_alias("Convolution_v1", "Convolution")
-_register_alias("Pooling_v1", "Pooling")
-_register_alias("BatchNorm_v1", "BatchNorm")
-_register_alias("CuDNNBatchNorm", "BatchNorm")
-_register_alias("_contrib_SparseEmbedding", "Embedding")
+
+register_alias("Convolution_v1", "Convolution")
+register_alias("Pooling_v1", "Pooling")
+register_alias("BatchNorm_v1", "BatchNorm")
+register_alias("CuDNNBatchNorm", "BatchNorm")
+register_alias("_contrib_SparseEmbedding", "Embedding")
+register_alias("_add", "elemwise_add")
+register_alias("_sub", "elemwise_sub")
+register_alias("_mod", "broadcast_mod")
+register_alias("_Mod", "broadcast_mod")
+register_alias("_Maximum", "broadcast_maximum")
+register_alias("_Minimum", "broadcast_minimum")
+register_alias("_Hypot", "broadcast_hypot")
+register_alias("_Greater_Equal", "broadcast_greater_equal")
+register_alias("_Lesser_Equal", "broadcast_lesser_equal")
+register_alias("_Logical_And", "broadcast_logical_and")
+register_alias("_Logical_Or", "broadcast_logical_or")
+register_alias("_Logical_Xor", "broadcast_logical_xor")
+register_alias("_LogicalAndScalar", "_logical_and_scalar")
+register_alias("_LogicalOrScalar", "_logical_or_scalar")
+register_alias("_LogicalXorScalar", "_logical_xor_scalar")
+# Crop-assign legacy names (src/operator/tensor/matrix_op.cc add_alias)
+register_alias("_crop_assign", "_slice_assign")
+register_alias("_crop_assign_scalar", "_slice_assign_scalar")
+# Sparse-storage scatter variants: dense-backed storage makes these the
+# plain elementwise ops (stored rows == all rows)
+register_alias("_scatter_plus_scalar", "_plus_scalar")
+register_alias("_scatter_minus_scalar", "_minus_scalar")
+register_alias("_scatter_elemwise_div", "elemwise_div")
+register_alias("_sparse_cast_storage", "cast_storage")
+register_alias("_sparse_dot", "dot")
+register_alias("_sparse_zeros_like", "zeros_like")

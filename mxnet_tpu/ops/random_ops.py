@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import Param, register
+from .registry import Param, register, register_alias
 
 
 def _t(*o):
@@ -164,3 +164,24 @@ _reg_sample("_sample_negative_binomial",
 _reg_sample("_sample_generalized_negative_binomial",
             lambda k, mu, al, e: _gen_neg_binomial(
                 k, _bcast(mu, e), _bcast(al, e), _samp_shape(mu, e)), 2)
+
+
+# ---------------------------------------------------------------------------
+# frontend alias names (reference registers these via add_alias on the
+# _random_* / _sample_* ops, src/operator/random/sample_op.cc)
+# ---------------------------------------------------------------------------
+
+for _a, _t_name in [
+        ("uniform", "_random_uniform"),
+        ("random_uniform", "_random_uniform"),
+        ("normal", "_random_normal"),
+        ("random_normal", "_random_normal"),
+        ("random_gamma", "_random_gamma"),
+        ("random_exponential", "_random_exponential"),
+        ("random_poisson", "_random_poisson"),
+        ("random_negative_binomial", "_random_negative_binomial"),
+        ("random_generalized_negative_binomial",
+         "_random_generalized_negative_binomial"),
+        ("sample_multinomial", "_sample_multinomial"),
+]:
+    register_alias(_a, _t_name)

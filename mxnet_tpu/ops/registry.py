@@ -187,6 +187,22 @@ def register(name, fcompute, *, params=None, inputs=("data",), num_outputs=1,
     return schema
 
 
+def register_alias(alias, name):
+    """Expose an already-registered op under an additional public name
+    (role of nnvm ``.add_alias``; e.g. legacy CamelCase / sparse names).
+    Unknown targets and clashes with a DIFFERENT op raise; re-aliasing to
+    the same op is a no-op."""
+    schema = get_op(name)
+    existing = _REGISTRY.get(alias)
+    if existing is not None:
+        if existing is schema:
+            return schema
+        raise MXNetError(f"op {alias!r} already registered to "
+                         f"{existing.name!r}")
+    _REGISTRY[alias] = schema
+    return schema
+
+
 def get_op(name) -> OpSchema:
     try:
         return _REGISTRY[name]

@@ -180,6 +180,15 @@ def _smooth_l1(attrs, octx, x):
 register("smooth_l1", _smooth_l1, params={"scalar": Param("float", 1.0)},
          infer_shape=_same_shape_infer(1))
 
+
+def _hard_sigmoid(attrs, octx, x):
+    return _t(jnp.clip(attrs["alpha"] * x + attrs["beta"], 0.0, 1.0))
+
+
+register("hard_sigmoid", _hard_sigmoid,
+         params={"alpha": Param("float", 0.2), "beta": Param("float", 0.5)},
+         infer_shape=_same_shape_infer(1))
+
 # ---------------------------------------------------------------------------
 # elementwise binary + broadcast families
 # ---------------------------------------------------------------------------
@@ -294,6 +303,11 @@ def _reduce_op(name, fn, aliases=()):
 
 
 _reduce_op("sum", jnp.sum, aliases=("sum_axis",))
+# sum-of-squares reduction (reference: sparse-aware square_sum.cc `_square_sum`;
+# dense-backed here, same numerics)
+_reduce_op("_square_sum",
+           lambda x, axis=None, keepdims=False: jnp.sum(
+               jnp.square(x), axis=axis, keepdims=keepdims))
 _reduce_op("mean", jnp.mean)
 _reduce_op("prod", jnp.prod)
 _reduce_op("nansum", jnp.nansum)

@@ -21,6 +21,15 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
 
 _kMagic = 0xced7230a
 
+try:
+    import cv2 as _cv2
+except ImportError:
+    _cv2 = None
+# Backend pack_img/unpack_img actually encode with. Exported so callers
+# (tools/im2rec.py) can match its channel convention (cv2 = BGR) without
+# re-probing and risking a desync.
+USES_CV2 = _cv2 is not None
+
 
 class MXRecordIO:
     """Sequential record reader/writer (recordio.py MXRecordIO)."""
@@ -207,16 +216,13 @@ def unpack_img(s, iscolor=-1):
 
 
 def _encode_img(img, quality, img_fmt):
-    try:
-        import cv2
-        flag = (cv2.IMWRITE_JPEG_QUALITY
+    if USES_CV2:
+        flag = (_cv2.IMWRITE_JPEG_QUALITY
                 if img_fmt.lower() in (".jpg", ".jpeg")
-                else cv2.IMWRITE_PNG_COMPRESSION)
-        ret, buf = cv2.imencode(img_fmt, img, [flag, quality])
+                else _cv2.IMWRITE_PNG_COMPRESSION)
+        ret, buf = _cv2.imencode(img_fmt, img, [flag, quality])
         assert ret, "failed to encode image"
         return buf.tobytes()
-    except ImportError:
-        pass
     import io as _io
     from PIL import Image
     pil = Image.fromarray(np.asarray(img).astype(np.uint8))
@@ -227,11 +233,8 @@ def _encode_img(img, quality, img_fmt):
 
 
 def _decode_img(s, iscolor=-1):
-    try:
-        import cv2
-        return cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
-    except ImportError:
-        pass
+    if USES_CV2:
+        return _cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
     import io as _io
     from PIL import Image
     return np.asarray(Image.open(_io.BytesIO(s)))

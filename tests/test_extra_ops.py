@@ -121,3 +121,56 @@ def test_legacy_aliases():
     sym = mx.sym.Convolution_v1(mx.sym.Variable("d"), kernel=(3, 3),
                                 num_filter=2, name="c")
     assert "c_weight" in sym.list_arguments()
+
+
+def test_hard_sigmoid_forward_grad():
+    xv = np.linspace(-6, 6, 13).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.hard_sigmoid(x, alpha=0.25, beta=0.4)
+        loss = nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), np.clip(0.25 * xv + 0.4, 0, 1),
+                               rtol=1e-6)
+    inside = (0.25 * xv + 0.4 > 0) & (0.25 * xv + 0.4 < 1)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.where(inside, 0.25, 0.0),
+                               rtol=1e-6)
+
+
+def test_square_sum_matches_dense():
+    rng = np.random.RandomState(7)
+    av = rng.normal(size=(4, 5)).astype(np.float32)
+    a = nd.array(av)
+    a.attach_grad()
+    with autograd.record():
+        y = nd._square_sum(a, axis=1)
+        loss = nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), (av ** 2).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * av, rtol=1e-5)
+
+
+def test_namespace_alias_parity():
+    # CamelCase / sparse / random frontend aliases resolve to the same ops
+    rng = np.random.RandomState(3)
+    av = rng.normal(size=(3, 4)).astype(np.float32)
+    bv = rng.normal(size=(3, 4)).astype(np.float32)
+    a, b = nd.array(av), nd.array(bv)
+    np.testing.assert_allclose(nd._add(a, b).asnumpy(), av + bv, rtol=1e-6)
+    np.testing.assert_allclose(nd._Maximum(a, b).asnumpy(),
+                               np.maximum(av, bv), rtol=1e-6)
+    np.testing.assert_allclose(nd._mod(a, b).asnumpy(),
+                               np.mod(av, bv), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd._LogicalAndScalar(a, scalar=1.0).asnumpy(),
+        np.logical_and(av != 0, True).astype(np.float32), rtol=1e-6)
+    assert nd.uniform(shape=(2, 3)).shape == (2, 3)
+    assert nd.random_normal(shape=(2,)).shape == (2,)
+    assert nd.sample_multinomial(nd.array(np.full((2, 4), 0.25,
+                                                  np.float32))).shape == (2,)
+    c = nd.array(np.arange(16, dtype=np.float32).reshape(4, 4))
+    got = nd._crop_assign(c, nd.zeros((2, 2)), begin=(1, 1), end=(3, 3))
+    want = c.asnumpy().copy()
+    want[1:3, 1:3] = 0
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)

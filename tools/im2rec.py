@@ -113,12 +113,7 @@ def _load_and_encode(args, rel, labels, idx):
         left, top = (w - s) // 2, (h - s) // 2
         img = img.crop((left, top, left + s, top + s))
     arr = np.asarray(img)
-    try:
-        import cv2  # noqa: F401
-        cv2_encoder = True
-    except ImportError:
-        cv2_encoder = False
-    if cv2_encoder and arr.ndim == 3 and arr.shape[-1] == 3:
+    if recordio.USES_CV2 and arr.ndim == 3 and arr.shape[-1] == 3:
         # recordio.pack_img encodes via cv2 (BGR); PIL loaded RGB — flip so
         # imdecode's BGR->RGB on read restores the original channel order.
         # PIL-only environments encode RGB verbatim: no flip. RGBA is left
@@ -138,24 +133,27 @@ def make_record(args, lst_path):
     entries = list(read_list(lst_path))
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     # stream with a bounded in-flight window so encoded payloads never
-    # accumulate beyond ~2x the worker count
-    if args.num_thread > 1:
-        from collections import deque
-        with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
-            window = deque()
-            for entry in entries:
-                window.append((entry[0], pool.submit(
-                    _load_and_encode, args, entry[1], entry[2], entry[0])))
-                if len(window) >= 2 * args.num_thread:
+    # accumulate beyond ~2x the worker count; close() in finally so the
+    # .idx for already-written records survives a bad image
+    try:
+        if args.num_thread > 1:
+            from collections import deque
+            with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
+                window = deque()
+                for entry in entries:
+                    window.append((entry[0], pool.submit(
+                        _load_and_encode, args, entry[1], entry[2], entry[0])))
+                    if len(window) >= 2 * args.num_thread:
+                        idx, fut = window.popleft()
+                        rec.write_idx(idx, fut.result())
+                while window:
                     idx, fut = window.popleft()
                     rec.write_idx(idx, fut.result())
-            while window:
-                idx, fut = window.popleft()
-                rec.write_idx(idx, fut.result())
-    else:
-        for idx, rel, labels in entries:
-            rec.write_idx(idx, _load_and_encode(args, rel, labels, idx))
-    rec.close()
+        else:
+            for idx, rel, labels in entries:
+                rec.write_idx(idx, _load_and_encode(args, rel, labels, idx))
+    finally:
+        rec.close()
     print(f"wrote {prefix}.rec ({len(entries)} records)")
 
 
