@@ -27,8 +27,8 @@ import numpy as _np
 from ..base import (MXNetError, parse_bool, parse_float, parse_int,
                     parse_shape)
 
-__all__ = ["Param", "OpSchema", "OpCtx", "register", "get_op", "list_ops",
-           "AttrDict"]
+__all__ = ["Param", "OpSchema", "OpCtx", "register", "register_alias",
+           "get_op", "list_ops", "AttrDict"]
 
 
 def _parse_floats(v):

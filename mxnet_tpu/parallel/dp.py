@@ -65,6 +65,10 @@ class DataParallelTrainer:
         self._lr = float(learning_rate)
         self._loss_index = loss_index
         self._t = 0
+        # device-carried step state (see step()): rng key, lr, step count
+        self._rng_dev = None
+        self._lr_dev = None
+        self._t_dev = None
         if dtype not in ("float32", "bfloat16"):
             raise MXNetError("DataParallelTrainer dtype must be float32 or "
                              "bfloat16")
@@ -113,6 +117,13 @@ class DataParallelTrainer:
         cast_input = [arg_names[p] in data_name_set for p in input_pos]
 
         def step(params, states, aux, inputs, rng, lr, t):
+            # rng and t are device-carried: split/increment INSIDE the
+            # compiled step so the host never dispatches a per-step key
+            # split or scalar transfer (through a remote PJRT tunnel each
+            # of those is a serializing round-trip)
+            rng, next_rng = jax.random.split(rng)
+            t = t + 1.0
+
             def loss_fn(params):
                 args = [None] * n_args
                 for p, v in zip(param_pos, params):
@@ -152,7 +163,7 @@ class DataParallelTrainer:
                 new_params.append(res[0])
                 new_states.append(tuple(res[1:]))
             return (tuple(new_params), tuple(new_states), new_aux, loss,
-                    outputs)
+                    outputs, next_rng, t)
 
         repl = NamedSharding(mesh, P())
         shard = NamedSharding(mesh, P(self._data_axis))
@@ -160,7 +171,7 @@ class DataParallelTrainer:
         self._step = jax.jit(
             step,
             in_shardings=(repl, repl, repl, shard, repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl, shard),
+            out_shardings=(repl, repl, repl, repl, shard, repl, repl),
             donate_argnums=(0, 1))
 
     @property
@@ -226,6 +237,7 @@ class DataParallelTrainer:
     def set_learning_rate(self, lr):
         """Schedules never retrace: lr is a traced input to the step."""
         self._lr = float(lr)
+        self._lr_dev = None  # re-commit on next step
 
     def replicate_inputs(self, arrays):
         """Commit host arrays to the mesh, replicated (e.g. eval inputs)."""
@@ -238,14 +250,20 @@ class DataParallelTrainer:
         return tuple(out)
 
     def step(self, params, states, aux, inputs, rng=None):
-        if rng is None:
+        if rng is not None:
+            # explicit key (tests/reproducibility): commit it to the mesh —
+            # it may have been minted on the default backend
+            self._rng_dev = jax.device_put(rng, self._repl)
+        elif self._rng_dev is None:
             from .. import random as _random
-            rng = _random.next_key()
-        # the key may have been minted on the default backend; commit it to
-        # the mesh so the step never mixes platforms
-        rng = jax.device_put(rng, self._repl)
-        self._t += 1
-        # host numpy scalars: jit commits them per in_shardings (never the
-        # default backend — see shard_inputs)
-        return self._step(params, states, aux, inputs, rng,
-                          _np.float32(self._lr), _np.float32(self._t))
+            self._rng_dev = jax.device_put(_random.next_key(), self._repl)
+        if self._lr_dev is None:
+            self._lr_dev = jax.device_put(_np.float32(self._lr), self._repl)
+        if self._t_dev is None:
+            self._t_dev = jax.device_put(_np.float32(self._t), self._repl)
+        out = self._step(params, states, aux, inputs, self._rng_dev,
+                         self._lr_dev, self._t_dev)
+        # rng/t are device-carried (split/incremented inside the step): the
+        # host never dispatches per-step key splits or scalar transfers
+        self._rng_dev, self._t_dev = out[5], out[6]
+        return out[:5]
