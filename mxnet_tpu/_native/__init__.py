@@ -37,18 +37,21 @@ def _compile():
     if os.path.exists(out) and \
             os.path.getmtime(out) >= os.path.getmtime(_SRC):
         return out
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
-           _SRC, "-o", out]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        # no OpenMP? retry without
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            _SRC, "-o", out, "-lpthread"]
+    # most-capable first: JPEG pipeline + OpenMP, then degrade
+    variants = [["-fopenmp", "-DMXIO_HAS_JPEG", "-ljpeg"],
+                ["-DMXIO_HAS_JPEG", "-ljpeg"],
+                ["-fopenmp"],
+                []]
+    for extra in variants:
         try:
-            cmd.remove("-fopenmp")
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            subprocess.run(base + extra, check=True, capture_output=True,
+                           timeout=120)
+            return out
         except (OSError, subprocess.SubprocessError):
-            return None
-    return out
+            continue
+    return None
 
 
 def _bind(path):
@@ -69,6 +72,19 @@ def _bind(path):
     lib.mxio_dequantize_2bit.argtypes = [P_U32, P_F, L, ctypes.c_float]
     lib.mxio_hwc_u8_to_chw_f32.restype = None
     lib.mxio_hwc_u8_to_chw_f32.argtypes = [P_U8, P_F, L, L, L, P_F, P_F]
+    lib.mxio_has_jpeg.restype = ctypes.c_int
+    lib.mxio_jpeg_decode.restype = ctypes.c_int
+    lib.mxio_jpeg_decode.argtypes = [P_U8, L, P_U8, L, P_L, P_L]
+    lib.mxio_pipe_create.restype = ctypes.c_void_p
+    lib.mxio_pipe_create.argtypes = [
+        ctypes.c_char_p, P_L, P_L, L, L, L, L, L, L,
+        ctypes.c_int, ctypes.c_int, P_F, P_F, L, L, L, ctypes.c_uint64]
+    lib.mxio_pipe_reset.restype = ctypes.c_int
+    lib.mxio_pipe_reset.argtypes = [ctypes.c_void_p, P_L, L]
+    lib.mxio_pipe_next.restype = ctypes.c_int
+    lib.mxio_pipe_next.argtypes = [ctypes.c_void_p, P_F, P_F, P_L]
+    lib.mxio_pipe_destroy.restype = None
+    lib.mxio_pipe_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -191,3 +207,126 @@ def hwc_u8_to_chw_f32(img, mean=None, std=None):
         mean_arr.ctypes.data_as(fptr) if mean_arr is not None else None,
         stdinv_arr.ctypes.data_as(fptr) if stdinv_arr is not None else None)
     return out
+
+
+def has_jpeg():
+    """True when the native lib was built with libjpeg (image pipeline)."""
+    L = lib()
+    return bool(L is not None and L.mxio_has_jpeg())
+
+
+def jpeg_decode(data):
+    """Decode JPEG bytes to an RGB uint8 HWC array, or None if the native
+    decoder is unavailable. Raises ValueError on corrupt input."""
+    L = lib()
+    if L is None or not L.mxio_has_jpeg():
+        return None
+    buf = _np.frombuffer(data, _np.uint8)
+    h = ctypes.c_long()
+    w = ctypes.c_long()
+    src = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+    if L.mxio_jpeg_decode(src, len(buf), None, 0,
+                          ctypes.byref(h), ctypes.byref(w)) != 0:
+        raise ValueError("corrupt JPEG")
+    out = _np.empty((h.value, w.value, 3), _np.uint8)
+    if L.mxio_jpeg_decode(
+            src, len(buf), out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            out.size, ctypes.byref(h), ctypes.byref(w)) != 0:
+        raise ValueError("corrupt JPEG")
+    return out
+
+
+class NativeImagePipe:
+    """Threaded C++ record->JPEG-decode->augment->batch pipeline
+    (iter_image_recordio_2.cc role). Delivers batches in deterministic
+    order for a fixed (seed, epoch order)."""
+
+    def __init__(self, rec_path, offsets, lengths, batch, data_shape,
+                 resize=0, rand_crop=False, rand_mirror=False, mean=None,
+                 std=None, label_width=1, nthreads=4, depth=0, seed=0):
+        L = lib()
+        if L is None or not L.mxio_has_jpeg():
+            raise MXNetNativeUnavailable("native JPEG pipeline unavailable")
+        c, h, w = data_shape
+        self._lib = L
+        self._batch = int(batch)
+        self._shape = (int(c), int(h), int(w))
+        self._label_width = int(label_width)
+        offsets = _np.ascontiguousarray(offsets, _np.int64)
+        lengths = _np.ascontiguousarray(lengths, _np.int64)
+        P_L = ctypes.POINTER(ctypes.c_long)
+        P_F = ctypes.POINTER(ctypes.c_float)
+        def _per_channel(v, name):
+            # C++ reads exactly `c` floats: broadcast scalars, reject other
+            # lengths (a short array would read out of bounds)
+            if v is None:
+                return None
+            arr = _np.asarray(v, _np.float32).ravel()
+            if arr.size == 1:
+                arr = _np.full(c, arr[0], _np.float32)
+            elif arr.size != c:
+                raise ValueError(f"{name} must be scalar or length {c}, "
+                                 f"got {arr.size}")
+            return _np.ascontiguousarray(arr)
+
+        mean_arr = _per_channel(mean, "mean")
+        std_arr = _per_channel(std, "std")
+        stdinv_arr = None if std_arr is None else \
+            _np.ascontiguousarray(1.0 / std_arr)
+        self._handle = L.mxio_pipe_create(
+            rec_path.encode(), offsets.ctypes.data_as(P_L),
+            lengths.ctypes.data_as(P_L), len(offsets), self._batch,
+            c, h, w, int(resize), int(bool(rand_crop)),
+            int(bool(rand_mirror)),
+            mean_arr.ctypes.data_as(P_F) if mean_arr is not None else None,
+            stdinv_arr.ctypes.data_as(P_F)
+            if stdinv_arr is not None else None,
+            self._label_width, int(nthreads),
+            # buffer-pool depth: each buffer is a full f32 batch (38MB at
+            # batch 64 / 224^2), so default to the reference's
+            # prefetch_buffer=4 rather than scaling with threads
+            int(depth) or min(4, max(2, int(nthreads))), int(seed))
+        if not self._handle:
+            raise MXNetNativeUnavailable("mxio_pipe_create failed")
+
+    def reset(self, order):
+        order = _np.ascontiguousarray(order, _np.int64)
+        rc = self._lib.mxio_pipe_reset(
+            self._handle,
+            order.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), len(order))
+        if rc != 0:
+            raise IOError("mxio_pipe_reset failed")
+
+    def next(self):
+        """(data[b,c,h,w] f32, label[b,label_width] f32, pad) or None at
+        epoch end. Raises IOError on decode/read errors."""
+        c, h, w = self._shape
+        data = _np.empty((self._batch, c, h, w), _np.float32)
+        label = _np.empty((self._batch, self._label_width), _np.float32)
+        pad = ctypes.c_long()
+        rc = self._lib.mxio_pipe_next(
+            self._handle,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(pad))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise IOError("native image pipeline failed (bad record or "
+                          "non-JPEG payload)")
+        return data, label, int(pad.value)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.mxio_pipe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class MXNetNativeUnavailable(RuntimeError):
+    """Raised when a native fast path cannot be used (no compiler/libjpeg)."""

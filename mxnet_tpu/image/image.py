@@ -274,6 +274,13 @@ class RandomGrayAug(Augmenter):
         return src
 
 
+# ImageNet channel statistics used for mean=True/std=True (shared by the
+# python augmenter pipeline and the native C++ iterator so the two paths
+# can never normalize differently)
+IMAGENET_DEFAULT_MEAN = np.array([123.68, 116.28, 103.53])
+IMAGENET_DEFAULT_STD = np.array([58.395, 57.12, 57.375])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -305,9 +312,9 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
+        mean = IMAGENET_DEFAULT_MEAN
     if std is True:
-        std = np.array([58.395, 57.12, 57.375])
+        std = IMAGENET_DEFAULT_STD
     if mean is not None or std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist

@@ -146,3 +146,525 @@ void mxio_hwc_u8_to_chw_f32(const unsigned char* src, float* dst, long h,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Native image pipeline — threaded record->decode->augment->batch engine.
+//
+// Parity role: src/io/iter_image_recordio_2.cc (chunk read + OMP-parallel
+// JPEG decode + augment + batch assembly) and iter_prefetcher.h (double
+// buffering). Worker threads claim batch sequence numbers, decode whole
+// batches into pooled buffers, and a consumer drains them IN ORDER, so
+// results are deterministic for a fixed (seed, epoch, order).
+// ===========================================================================
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#if defined(MXIO_HAS_JPEG)
+#include <csetjmp>
+#include <jpeglib.h>
+#endif
+
+namespace {
+
+#if defined(MXIO_HAS_JPEG)
+struct JpegErr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jmp;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  std::longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jmp, 1);
+}
+
+// Decode a JPEG into interleaved RGB u8. Returns 0 and fills (h,w) on
+// success; -1 on any decode error. `out` may be null to query dims only
+// (capacity = max bytes out can hold).
+int DecodeJpegRGB(const unsigned char* data, long len, unsigned char* out,
+                  long capacity, long* h, long* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  if (!out) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  const long stride = 3L * cinfo.output_width;
+  if (stride * cinfo.output_height > capacity) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+
+// Single-pass decode into a caller-owned scratch vector (resized to fit).
+// Rejects absurd dimensions (corrupt/crafted headers) instead of trying
+// to allocate; returns 0 on success, -1 on any error.
+int DecodeJpegRGBScratch(const unsigned char* data, long len,
+                         std::vector<unsigned char>& out, long* h, long* w) {
+  constexpr long kMaxPixels = 64L * 1024 * 1024;  // 64 MP sanity cap
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const long oh = cinfo.output_height, ow = cinfo.output_width;
+  if (oh <= 0 || ow <= 0 || oh * ow > kMaxPixels) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  out.resize(static_cast<size_t>(oh) * ow * 3);
+  const long stride = 3L * ow;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out.data() + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *h = oh;
+  *w = ow;
+  return 0;
+}
+
+#endif  // MXIO_HAS_JPEG
+
+// Bilinear resize of interleaved RGB u8 (align_corners=false convention,
+// matching cv2.INTER_LINEAR / PIL BILINEAR up to rounding).
+void ResizeBilinearRGB(const unsigned char* src, long sh, long sw,
+                       unsigned char* dst, long dh, long dw) {
+  const float ys = static_cast<float>(sh) / dh;
+  const float xs = static_cast<float>(sw) / dw;
+  for (long y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * ys - 0.5f;
+    if (fy < 0) fy = 0;
+    long y0 = static_cast<long>(fy);
+    if (y0 > sh - 1) y0 = sh - 1;
+    long y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    const float wy = fy - y0;
+    for (long x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * xs - 0.5f;
+      if (fx < 0) fx = 0;
+      long x0 = static_cast<long>(fx);
+      if (x0 > sw - 1) x0 = sw - 1;
+      long x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      const float wx = fx - x0;
+      for (long ch = 0; ch < 3; ++ch) {
+        const float v00 = src[(y0 * sw + x0) * 3 + ch];
+        const float v01 = src[(y0 * sw + x1) * 3 + ch];
+        const float v10 = src[(y1 * sw + x0) * 3 + ch];
+        const float v11 = src[(y1 * sw + x1) * 3 + ch];
+        const float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                        v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * 3 + ch] =
+            static_cast<unsigned char>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// xorshift64* — deterministic per-(seed,epoch,record) augmentation RNG
+inline uint64_t NextRand(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *s = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+struct PipeConfig {
+  long batch, C, H, W;
+  long resize_short;          // 0 = no resize
+  int rand_crop, rand_mirror;
+  std::vector<float> mean, stdinv;  // size C or empty
+  long label_width;
+  uint64_t seed;
+};
+
+struct BatchBuf {
+  std::vector<float> data;    // batch*C*H*W
+  std::vector<float> label;   // batch*label_width
+  long pad = 0;
+};
+
+struct Pipe {
+  PipeConfig cfg;
+  FILE* fp = nullptr;
+  std::mutex fp_mu;
+  std::vector<long> offsets, lengths;   // full record table
+  std::vector<long> order;              // epoch order (indices into table)
+  uint64_t epoch = 0;
+
+  long nthreads = 1;
+  long nbatches = 0;
+  std::atomic<long> next_claim{0};
+  long next_deliver = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::map<long, BatchBuf*> ready;
+  std::vector<BatchBuf*> freelist;
+  std::vector<BatchBuf*> all_bufs;
+  std::atomic<int> error{0};
+  bool stopping = false;
+
+  std::vector<std::thread> workers;
+
+  ~Pipe() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_free.notify_all();
+    for (auto& t : workers) t.join();
+    for (auto* b : all_bufs) delete b;
+    if (fp) std::fclose(fp);
+  }
+};
+
+// Decode + augment one record payload into batch slot i. `raw`/`resized`
+// are per-worker scratch buffers reused across records. Returns 0/-1.
+int ProcessRecord(Pipe* p, const unsigned char* payload, long len,
+                  uint64_t rng_seed, float* data_slot, float* label_slot,
+                  std::vector<unsigned char>& raw,
+                  std::vector<unsigned char>& resized) {
+#if !defined(MXIO_HAS_JPEG)
+  (void)p; (void)payload; (void)len; (void)rng_seed; (void)data_slot;
+  (void)label_slot; (void)raw; (void)resized;
+  return -1;
+#else
+  const PipeConfig& c = p->cfg;
+  if (len < 24) return -1;
+  uint32_t flag;
+  float flabel;
+  std::memcpy(&flag, payload, 4);
+  std::memcpy(&flabel, payload + 4, 4);
+  const unsigned char* img = payload + 24;
+  long img_len = len - 24;
+  for (long j = 0; j < c.label_width; ++j) label_slot[j] = 0.0f;
+  if (flag > 0) {
+    if (img_len < static_cast<long>(flag) * 4) return -1;
+    const long ncopy = flag < static_cast<uint32_t>(c.label_width)
+                           ? flag : c.label_width;
+    std::memcpy(label_slot, img, ncopy * 4);
+    img += flag * 4;
+    img_len -= flag * 4;
+  } else {
+    label_slot[0] = flabel;
+  }
+  if (img_len < 2 || img[0] != 0xFF || img[1] != 0xD8) return -1;  // not JPEG
+
+  long sh = 0, sw = 0;
+  if (DecodeJpegRGBScratch(img, img_len, raw, &sh, &sw) != 0) return -1;
+
+  const unsigned char* cur = raw.data();
+  long ch_ = sh, cw = sw;
+  if (c.resize_short > 0 && (sh < sw ? sh : sw) != c.resize_short) {
+    const long short_side = sh < sw ? sh : sw;
+    const double scale = static_cast<double>(c.resize_short) / short_side;
+    long nh = static_cast<long>(sh * scale + 0.5);
+    long nw = static_cast<long>(sw * scale + 0.5);
+    if (sh < sw) nh = c.resize_short; else nw = c.resize_short;
+    resized.resize(static_cast<size_t>(nh) * nw * 3);
+    ResizeBilinearRGB(raw.data(), sh, sw, resized.data(), nh, nw);
+    cur = resized.data();
+    ch_ = nh;
+    cw = nw;
+  }
+  if (ch_ < c.H || cw < c.W) return -1;  // too small to crop (reference errors)
+
+  uint64_t rs = rng_seed;
+  long y0 = (ch_ - c.H) / 2, x0 = (cw - c.W) / 2;
+  if (c.rand_crop) {
+    y0 = ch_ == c.H ? 0 : static_cast<long>(NextRand(&rs) % (ch_ - c.H + 1));
+    x0 = cw == c.W ? 0 : static_cast<long>(NextRand(&rs) % (cw - c.W + 1));
+  }
+  const bool mirror = c.rand_mirror && (NextRand(&rs) & 1);
+
+  const long plane = c.H * c.W;
+  for (long ch = 0; ch < c.C; ++ch) {
+    const float m = ch < static_cast<long>(c.mean.size()) ? c.mean[ch] : 0.0f;
+    const float si = ch < static_cast<long>(c.stdinv.size())
+                         ? c.stdinv[ch] : 1.0f;
+    float* out_plane = data_slot + ch * plane;
+    for (long y = 0; y < c.H; ++y) {
+      const unsigned char* row = cur + ((y0 + y) * cw + x0) * 3;
+      float* orow = out_plane + y * c.W;
+      if (!mirror) {
+        for (long x = 0; x < c.W; ++x)
+          orow[x] = (static_cast<float>(row[x * 3 + ch]) - m) * si;
+      } else {
+        for (long x = 0; x < c.W; ++x)
+          orow[x] = (static_cast<float>(row[(c.W - 1 - x) * 3 + ch]) - m) * si;
+      }
+    }
+  }
+  return 0;
+#endif
+}
+
+bool g_pipe_debug = std::getenv("MXIO_PIPE_DEBUG") != nullptr;
+
+void WorkerLoop(Pipe* p) {
+  const PipeConfig& c = p->cfg;
+  const long slot_sz = c.C * c.H * c.W;
+  std::vector<unsigned char> rec_buf, raw_scratch, resized_scratch;
+  constexpr long kMaxRecordBytes = 256L * 1024 * 1024;
+  for (;;) {
+    // Acquire a buffer BEFORE claiming a sequence number. Claiming first
+    // deadlocks: with all buffers holding batches AHEAD of the in-order
+    // delivery point, the worker that claimed the next-needed batch waits
+    // for a buffer the consumer will never free (it is waiting for that
+    // very batch). Buffer-first, every claimed batch is processable.
+    BatchBuf* buf = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->cv_free.wait(lk, [&] {
+        return p->stopping || p->error.load() || !p->freelist.empty();
+      });
+      if (p->stopping || p->error.load()) return;
+      buf = p->freelist.back();
+      p->freelist.pop_back();
+    }
+    const long seq = p->next_claim.fetch_add(1);
+    if (g_pipe_debug)
+      std::fprintf(stderr, "[mxio] worker claimed seq %ld (has buffer)\n",
+                   seq);
+    if (seq >= p->nbatches || p->error.load()) {
+      {
+        std::lock_guard<std::mutex> lk(p->mu);
+        p->freelist.push_back(buf);
+      }
+      p->cv_free.notify_all();
+      return;
+    }
+    const long start = seq * c.batch;
+    const long n_items = static_cast<long>(p->order.size());
+    buf->pad = start + c.batch > n_items ? start + c.batch - n_items : 0;
+    int rc = 0;
+    // contain allocation failures (corrupt length tables / dimension
+    // bombs) to this batch: error flag + IOError in python, not terminate
+    try {
+      for (long i = 0; i < c.batch && rc == 0; ++i) {
+        // round_batch semantics: wrap into the epoch head for the tail pad
+        const long idx = p->order[(start + i) % n_items];
+        long off = p->offsets[idx], ln = p->lengths[idx];
+        if (ln <= 0 || ln > kMaxRecordBytes) {
+          rc = -1;
+          break;
+        }
+        rec_buf.resize(ln);
+        {
+          std::lock_guard<std::mutex> lk(p->fp_mu);
+          if (std::fseek(p->fp, off, SEEK_SET) != 0 ||
+              std::fread(rec_buf.data(), 1, ln, p->fp) !=
+                  static_cast<size_t>(ln)) {
+            rc = -1;
+            break;
+          }
+        }
+        const uint64_t rseed =
+            (p->cfg.seed * 1000003ULL + p->epoch) * 0x9E3779B97F4A7C15ULL +
+            static_cast<uint64_t>(idx) + 1;
+        uint64_t rs = rseed;
+        NextRand(&rs);
+        rc = ProcessRecord(p, rec_buf.data(), ln, rs,
+                           buf->data.data() + i * slot_sz,
+                           buf->label.data() + i * c.label_width,
+                           raw_scratch, resized_scratch);
+      }
+    } catch (...) {
+      rc = -1;
+    }
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      if (rc != 0) {
+        p->error.store(1);
+        p->freelist.push_back(buf);
+      } else {
+        p->ready[seq] = buf;
+      }
+    }
+    if (g_pipe_debug)
+      std::fprintf(stderr, "[mxio] worker pushed seq %ld rc=%d\n", seq, rc);
+    p->cv_ready.notify_all();
+    if (rc != 0) {
+      p->cv_free.notify_all();
+      return;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxio_has_jpeg() {
+#if defined(MXIO_HAS_JPEG)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// Decode one JPEG to RGB u8. Query dims with out=null. Returns 0 / -1.
+int mxio_jpeg_decode(const unsigned char* data, long len, unsigned char* out,
+                     long capacity, long* h, long* w) {
+#if defined(MXIO_HAS_JPEG)
+  return DecodeJpegRGB(data, len, out, capacity, h, w);
+#else
+  (void)data; (void)len; (void)out; (void)capacity; (void)h; (void)w;
+  return -1;
+#endif
+}
+
+void* mxio_pipe_create(const char* rec_path, const long* offsets,
+                       const long* lengths, long n_records, long batch,
+                       long C, long H, long W, long resize_short,
+                       int rand_crop, int rand_mirror, const float* mean,
+                       const float* stdinv, long label_width, long nthreads,
+                       long depth, uint64_t seed) {
+#if !defined(MXIO_HAS_JPEG)
+  return nullptr;
+#endif
+  if (C != 3 || n_records <= 0 || batch <= 0) return nullptr;
+  Pipe* p = new Pipe();
+  p->fp = std::fopen(rec_path, "rb");
+  if (!p->fp) {
+    delete p;
+    return nullptr;
+  }
+  p->cfg = PipeConfig{batch, C, H, W, resize_short, rand_crop, rand_mirror,
+                      mean ? std::vector<float>(mean, mean + C)
+                           : std::vector<float>(),
+                      stdinv ? std::vector<float>(stdinv, stdinv + C)
+                             : std::vector<float>(),
+                      label_width, seed};
+  p->offsets.assign(offsets, offsets + n_records);
+  p->lengths.assign(lengths, lengths + n_records);
+  if (depth < 2) depth = 2;
+  for (long i = 0; i < depth; ++i) {
+    BatchBuf* b = new BatchBuf();
+    b->data.resize(static_cast<size_t>(batch) * C * H * W);
+    b->label.resize(static_cast<size_t>(batch) * label_width);
+    p->all_bufs.push_back(b);
+    p->freelist.push_back(b);
+  }
+  // workers are (re)spawned per epoch by mxio_pipe_reset
+  p->nthreads = nthreads < 1 ? 1 : nthreads;
+  p->nbatches = 0;
+  p->next_claim.store(0);
+  return p;
+}
+
+// Start an epoch over `order` (indices into the record table). Spawns the
+// worker pool. Must be called before the first next(); subsequent calls
+// re-arm after EOF.
+int mxio_pipe_reset(void* handle, const long* order, long n) {
+  Pipe* p = static_cast<Pipe*>(handle);
+  if (!p || n <= 0) return -1;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->cv_free.notify_all();
+  for (auto& t : p->workers) t.join();
+  p->workers.clear();
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = false;
+    p->error.store(0);
+    for (auto& kv : p->ready) p->freelist.push_back(kv.second);
+    p->ready.clear();
+  }
+  p->order.assign(order, order + n);
+  p->epoch += 1;
+  p->nbatches = (n + p->cfg.batch - 1) / p->cfg.batch;
+  p->next_claim.store(0);
+  p->next_deliver = 0;
+  long spawn = p->nthreads < p->nbatches ? p->nthreads : p->nbatches;
+  for (long i = 0; i < spawn; ++i)
+    p->workers.emplace_back(WorkerLoop, p);
+  return 0;
+}
+
+// Fill data[batch*C*H*W] and label[batch*label_width]; *pad = #wrapped
+// tail records in this batch. Returns 0 ok, 1 epoch done, -1 error.
+int mxio_pipe_next(void* handle, float* data, float* label, long* pad) {
+  Pipe* p = static_cast<Pipe*>(handle);
+  if (!p) return -1;
+  if (p->next_deliver >= p->nbatches) return 1;
+  BatchBuf* buf = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [&] {
+      return p->error.load() ||
+             p->ready.count(p->next_deliver) > 0;
+    });
+    if (p->error.load() && p->ready.count(p->next_deliver) == 0) return -1;
+    buf = p->ready[p->next_deliver];
+    p->ready.erase(p->next_deliver);
+  }
+  std::memcpy(data, buf->data.data(), buf->data.size() * sizeof(float));
+  std::memcpy(label, buf->label.data(), buf->label.size() * sizeof(float));
+  if (pad) *pad = buf->pad;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->freelist.push_back(buf);
+  }
+  if (g_pipe_debug)
+    std::fprintf(stderr, "[mxio] consumer freed buffer after seq %ld\n",
+                 p->next_deliver);
+  p->cv_free.notify_all();
+  p->next_deliver += 1;
+  return 0;
+}
+
+void mxio_pipe_destroy(void* handle) {
+  delete static_cast<Pipe*>(handle);
+}
+
+}  // extern "C"

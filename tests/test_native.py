@@ -139,3 +139,145 @@ def test_hwc_to_chw():
     np.testing.assert_allclose(plain,
                                np.transpose(img.astype(np.float32),
                                             (2, 0, 1)))
+
+
+_jpeg = pytest.mark.skipif(not _native.has_jpeg(),
+                           reason="native lib built without libjpeg")
+
+
+def _write_img_rec(tmp_path, n=10, size=(40, 50), fmt=".jpg", label_width=1):
+    rec_path = tmp_path / "d.rec"
+    idx_path = tmp_path / "d.idx"
+    rec = recordio.MXIndexedRecordIO(str(idx_path), str(rec_path), "w")
+    yy = np.arange(size[0])[:, None, None]
+    xx = np.arange(size[1])[None, :, None]
+    cc = np.arange(3)[None, None, :]
+    for i in range(n):
+        # smooth gradients: JPEG decoders/resizers agree closely on these,
+        # so parity tolerances stay tight (noise images would amplify
+        # legitimate IDCT/bilinear implementation differences)
+        img = ((yy * 3 + xx * 2 + cc * 40 + i * 17) % 256).astype(np.uint8)
+        if label_width == 1:
+            hdr = recordio.IRHeader(0, float(i), i, 0)
+        else:
+            hdr = recordio.IRHeader(label_width,
+                                    np.arange(label_width, dtype=np.float32)
+                                    + i, i, 0)
+        rec.write_idx(i, recordio.pack_img(hdr, img, quality=95,
+                                           img_fmt=fmt))
+    rec.close()
+    return str(rec_path), str(idx_path)
+
+
+@_jpeg
+def test_native_jpeg_decode_matches_python(tmp_path):
+    rec_path, _ = _write_img_rec(tmp_path, n=1)
+    raw = recordio.MXRecordIO(rec_path, "r").read()
+    _, payload = recordio.unpack(raw)
+    native = _native.jpeg_decode(payload)
+    ref = recordio._decode_img(payload)
+    if recordio.USES_CV2:
+        ref = ref[..., ::-1]  # cv2 decodes BGR
+    assert native.shape == ref.shape
+    # different IDCT implementations may differ by a couple of levels
+    assert np.abs(native.astype(int) - ref.astype(int)).mean() < 2.0
+
+
+@_jpeg
+def test_native_image_record_iter_matches_python(tmp_path):
+    from mxnet_tpu.image.io import (ImageRecordIter, _NativeImageRecordIter,
+                                    _RawImageRecordIter)
+    rec_path, idx_path = _write_img_rec(tmp_path, n=10)
+    it = ImageRecordIter(rec_path, (3, 32, 32), 4, path_imgidx=idx_path,
+                         resize=36, preprocess_threads=2)
+    assert isinstance(it, _NativeImageRecordIter)
+    py = _RawImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                             data_shape=(3, 32, 32), batch_size=4,
+                             resize=36)
+    for bi in range(3):
+        nb = it.next()
+        pb = py.next()
+        assert nb.pad == pb.pad
+        keep = 4 - nb.pad  # pad rows differ by design: native wraps to the
+        # epoch head (reference round_batch), python repeats tail records
+        np.testing.assert_allclose(nb.label[0].asnumpy()[:keep],
+                                   pb.label[0].asnumpy()[:keep])
+        nd_, pd_ = nb.data[0].asnumpy(), pb.data[0].asnumpy()
+        assert nd_.shape == pd_.shape == (4, 3, 32, 32)
+        # decoder + bilinear kernels differ slightly; compare content
+        assert np.abs(nd_[:keep] - pd_[:keep]).mean() < 4.0
+    for obj in (it, py):
+        try:
+            obj.close()
+        except AttributeError:
+            pass
+
+
+@_jpeg
+def test_native_iter_shuffle_deterministic(tmp_path):
+    from mxnet_tpu.image.io import ImageRecordIter, _NativeImageRecordIter
+    rec_path, idx_path = _write_img_rec(tmp_path, n=8, size=(32, 32))
+    def labels_of(seed):
+        it = ImageRecordIter(rec_path, (3, 32, 32), 4, shuffle=True,
+                             seed=seed)
+        assert isinstance(it, _NativeImageRecordIter)
+        out = []
+        for b in it:
+            out.extend(b.label[0].asnumpy().tolist())
+        it.close()
+        return out
+    a, b = labels_of(3), labels_of(3)
+    assert a == b
+    assert sorted(a) == list(range(8))
+    assert labels_of(4) != a or labels_of(5) != a
+
+
+@_jpeg
+def test_native_iter_multilabel_and_parts(tmp_path):
+    from mxnet_tpu.image.io import ImageRecordIter, _NativeImageRecordIter
+    rec_path, _ = _write_img_rec(tmp_path, n=8, size=(32, 32),
+                                 label_width=3)
+    it = ImageRecordIter(rec_path, (3, 32, 32), 2, label_width=3,
+                         num_parts=2, part_index=1)
+    assert isinstance(it, _NativeImageRecordIter)
+    batch = it.next()
+    assert batch.label[0].shape == (2, 3)
+    np.testing.assert_allclose(batch.label[0].asnumpy()[0],
+                               [4.0, 5.0, 6.0])
+    it.close()
+
+
+@_jpeg
+def test_non_jpeg_falls_back_to_python(tmp_path):
+    from mxnet_tpu.image.io import ImageRecordIter, _NativeImageRecordIter
+    rec_path, idx_path = _write_img_rec(tmp_path, n=4, size=(32, 32),
+                                        fmt=".png")
+    it = ImageRecordIter(rec_path, (3, 32, 32), 2, path_imgidx=idx_path)
+    assert not isinstance(it, _NativeImageRecordIter)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+
+
+@_jpeg
+def test_native_pipe_more_workers_than_buffers(tmp_path):
+    # regression: workers used to claim a batch seq BEFORE acquiring a
+    # buffer; with every buffer holding a batch ahead of the in-order
+    # delivery point the pipeline deadlocked (buffers < workers makes the
+    # out-of-order window easy to hit). A slow consumer widens it.
+    import time
+    rec_path, _ = _write_img_rec(tmp_path, n=40, size=(32, 32))
+    offs, lens = _native.scan_records(rec_path)
+    pipe = _native.NativeImagePipe(rec_path, offs, lens, batch=2,
+                                   data_shape=(3, 32, 32), nthreads=4,
+                                   depth=2, seed=0)
+    for epoch in range(2):
+        pipe.reset(np.arange(40))
+        seen = 0
+        while True:
+            out = pipe.next()
+            if out is None:
+                break
+            seen += 1
+            time.sleep(0.005)
+        assert seen == 20
+    pipe.close()
