@@ -213,9 +213,15 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, prefetch_buffer=2,
     # augmentation kwargs with EFFECT; a falsy unsupported kwarg
     # (brightness=0.0) is behaviorally absent, so it neither blocks the
     # native path nor is forwarded to it
+
+    def _has_effect(v):
+        if isinstance(v, np.ndarray):  # bool(array) raises for size > 1
+            return v.size > 0
+        return bool(v)
+
     aug_keys = {k for k, v in kwargs.items()
                 if k not in _pass_keys + ("path_imgidx", "round_batch")
-                and v}
+                and _has_effect(v)}
     if (not os.environ.get("MXNET_TPU_DISABLE_NATIVE_ITER")
             and _native.has_jpeg()
             and tuple(data_shape)[0] == 3

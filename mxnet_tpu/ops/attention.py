@@ -47,7 +47,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
     import jax.experimental.pallas as pl
 
     q_block = q_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32) * scale            # (Bq, D)
+    # keep q in its storage dtype: the MXU runs bf16 matmuls at full rate
+    # while an fp32 upcast would halve+ throughput; accumulation happens
+    # in fp32 via preferred_element_type, and the scale is applied to the
+    # fp32 scores (numerically at least as good as scaling q)
+    q = q_ref[:]                                        # (Bq, D)
     q_start = pl.program_id(1) * q_block
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, 1), 0)
 
@@ -64,9 +68,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
     def body(i, carry):
         acc, m, l = carry
         start = i * block_k
-        k_blk = k_ref[pl.dslice(start, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(start, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T                                  # (Bq, Bk)
+        k_blk = k_ref[pl.dslice(start, block_k), :]
+        v_blk = v_ref[pl.dslice(start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
             k_pos = start + jax.lax.broadcasted_iota(jnp.int32,
                                                      (1, block_k), 1)
@@ -78,7 +84,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
         p = jnp.where(jnp.isneginf(s), 0.0, p)
         corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
         l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * corr + p @ v_blk
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))

@@ -182,56 +182,15 @@ void jpeg_err_exit(j_common_ptr cinfo) {
   std::longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jmp, 1);
 }
 
-// Decode a JPEG into interleaved RGB u8. Returns 0 and fills (h,w) on
-// success; -1 on any decode error. `out` may be null to query dims only
-// (capacity = max bytes out can hold).
-int DecodeJpegRGB(const unsigned char* data, long len, unsigned char* out,
-                  long capacity, long* h, long* w) {
-  jpeg_decompress_struct cinfo;
-  JpegErr jerr;
-  cinfo.err = jpeg_std_error(&jerr.pub);
-  jerr.pub.error_exit = jpeg_err_exit;
-  if (setjmp(jerr.jmp)) {
-    jpeg_destroy_decompress(&cinfo);
-    return -1;
-  }
-  jpeg_create_decompress(&cinfo);
-  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
-               static_cast<unsigned long>(len));
-  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
-    jpeg_destroy_decompress(&cinfo);
-    return -1;
-  }
-  cinfo.out_color_space = JCS_RGB;
-  jpeg_start_decompress(&cinfo);
-  *h = cinfo.output_height;
-  *w = cinfo.output_width;
-  if (!out) {
-    jpeg_abort_decompress(&cinfo);
-    jpeg_destroy_decompress(&cinfo);
-    return 0;
-  }
-  const long stride = 3L * cinfo.output_width;
-  if (stride * cinfo.output_height > capacity) {
-    jpeg_abort_decompress(&cinfo);
-    jpeg_destroy_decompress(&cinfo);
-    return -1;
-  }
-  while (cinfo.output_scanline < cinfo.output_height) {
-    JSAMPROW row = out + stride * cinfo.output_scanline;
-    jpeg_read_scanlines(&cinfo, &row, 1);
-  }
-  jpeg_finish_decompress(&cinfo);
-  jpeg_destroy_decompress(&cinfo);
-  return 0;
-}
-
-
-// Single-pass decode into a caller-owned scratch vector (resized to fit).
-// Rejects absurd dimensions (corrupt/crafted headers) instead of trying
-// to allocate; returns 0 on success, -1 on any error.
-int DecodeJpegRGBScratch(const unsigned char* data, long len,
-                         std::vector<unsigned char>& out, long* h, long* w) {
+// Single JPEG decode core shared by the dims-query/caller-buffer ABI
+// (mxio_jpeg_decode) and the pipeline's growable-scratch path. Decodes
+// interleaved RGB u8. Modes: out==null && scratch==null -> dims query;
+// out!=null -> capacity-checked write; scratch!=null -> resized to fit.
+// The 64MP cap applies to every mode (dimension-bomb headers must not
+// reach the caller's allocator). Returns 0 on success, -1 on error.
+int DecodeJpegCore(const unsigned char* data, long len, unsigned char* out,
+                   long capacity, std::vector<unsigned char>* scratch,
+                   long* h, long* w) {
   constexpr long kMaxPixels = 64L * 1024 * 1024;  // 64 MP sanity cap
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
@@ -256,17 +215,39 @@ int DecodeJpegRGBScratch(const unsigned char* data, long len,
     jpeg_destroy_decompress(&cinfo);
     return -1;
   }
-  out.resize(static_cast<size_t>(oh) * ow * 3);
+  *h = oh;
+  *w = ow;
   const long stride = 3L * ow;
+  unsigned char* dst = out;
+  if (scratch) {
+    scratch->resize(static_cast<size_t>(oh) * ow * 3);
+    dst = scratch->data();
+  } else if (!out) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 0;  // dims query
+  } else if (stride * oh > capacity) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
   while (cinfo.output_scanline < cinfo.output_height) {
-    JSAMPROW row = out.data() + stride * cinfo.output_scanline;
+    JSAMPROW row = dst + stride * cinfo.output_scanline;
     jpeg_read_scanlines(&cinfo, &row, 1);
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
-  *h = oh;
-  *w = ow;
   return 0;
+}
+
+int DecodeJpegRGB(const unsigned char* data, long len, unsigned char* out,
+                  long capacity, long* h, long* w) {
+  return DecodeJpegCore(data, len, out, capacity, nullptr, h, w);
+}
+
+int DecodeJpegRGBScratch(const unsigned char* data, long len,
+                         std::vector<unsigned char>& out, long* h, long* w) {
+  return DecodeJpegCore(data, len, nullptr, 0, &out, h, w);
 }
 
 #endif  // MXIO_HAS_JPEG
@@ -554,7 +535,11 @@ int mxio_has_jpeg() {
 int mxio_jpeg_decode(const unsigned char* data, long len, unsigned char* out,
                      long capacity, long* h, long* w) {
 #if defined(MXIO_HAS_JPEG)
-  return DecodeJpegRGB(data, len, out, capacity, h, w);
+  try {
+    return DecodeJpegRGB(data, len, out, capacity, h, w);
+  } catch (...) {
+    return -1;  // never let a C++ exception cross the C ABI
+  }
 #else
   (void)data; (void)len; (void)out; (void)capacity; (void)h; (void)w;
   return -1;
