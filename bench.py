@@ -63,14 +63,18 @@ def _train_ips(sym, mesh, dtype):
         params, states, aux, loss, _ = trainer.step(params, states, aux,
                                                     inputs)
     float(loss)
-    n_steps = 20
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, states, aux, loss, _ = trainer.step(params, states, aux,
-                                                    inputs)
-    float(loss)  # block on the chain
-    return n_steps * TRAIN_BATCH / (time.perf_counter() - t0), trainer, \
-        params, aux, x, y
+    # median of 3 trials: the shared chip/tunnel shows transient
+    # contention windows (3-4x inflation observed); the median resists a
+    # single bad window without the upward bias of best-of
+    n_steps, rates = 20, []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, states, aux, loss, _ = trainer.step(params, states, aux,
+                                                        inputs)
+        float(loss)  # block on the chain
+        rates.append(n_steps * TRAIN_BATCH / (time.perf_counter() - t0))
+    return sorted(rates)[1], trainer, params, aux, x, y
 
 
 def main():
@@ -103,13 +107,15 @@ def main():
     # "rates" vs 200ms/step real), so a small device->host fetch is the
     # reliable completion barrier here
     np.asarray(infer(argv, aux, key))
-    n_inf = 50
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n_inf):
-        out = infer(argv, aux, key)
-    np.asarray(out)
-    infer_ips = n_inf * INFER_BATCH / (time.perf_counter() - t0)
+    n_inf, inf_rates = 50, []
+    for _ in range(3):  # median-of-3 against transient tunnel contention
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_inf):
+            out = infer(argv, aux, key)
+        np.asarray(out)
+        inf_rates.append(n_inf * INFER_BATCH / (time.perf_counter() - t0))
+    infer_ips = sorted(inf_rates)[1]
 
     print(json.dumps({
         "metric": "resnet50_train_throughput",
@@ -123,6 +129,7 @@ def main():
         "inference_b32_ips": round(infer_ips, 2),
         "inference_vs_baseline": round(infer_ips / K80_RN50_INFER_B32, 2),
         "vs_k80_resnet152_train": round(train_ips / K80_RN152_TRAIN, 2),
+        "timing": "median-of-3x20-steps",
     }))
 
 

@@ -228,6 +228,9 @@ def jpeg_decode(data):
     if L.mxio_jpeg_decode(src, len(buf), None, 0,
                           ctypes.byref(h), ctypes.byref(w)) != 0:
         raise ValueError("corrupt JPEG")
+    if h.value * w.value > 64 * 1024 * 1024:
+        raise ValueError(f"JPEG too large: {h.value}x{w.value} exceeds the "
+                         "64MP native-decoder cap (decode with PIL/cv2)")
     out = _np.empty((h.value, w.value, 3), _np.uint8)
     if L.mxio_jpeg_decode(
             src, len(buf), out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),

@@ -186,8 +186,10 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 // (mxio_jpeg_decode) and the pipeline's growable-scratch path. Decodes
 // interleaved RGB u8. Modes: out==null && scratch==null -> dims query;
 // out!=null -> capacity-checked write; scratch!=null -> resized to fit.
-// The 64MP cap applies to every mode (dimension-bomb headers must not
-// reach the caller's allocator). Returns 0 on success, -1 on error.
+// The 64MP dimension-bomb cap applies only to scratch mode, where WE
+// allocate; the dims query allocates nothing (callers apply their own
+// policy) and the caller-buffer mode is bounded by `capacity`.
+// Returns 0 on success, -1 on error.
 int DecodeJpegCore(const unsigned char* data, long len, unsigned char* out,
                    long capacity, std::vector<unsigned char>* scratch,
                    long* h, long* w) {
@@ -210,7 +212,7 @@ int DecodeJpegCore(const unsigned char* data, long len, unsigned char* out,
   cinfo.out_color_space = JCS_RGB;
   jpeg_start_decompress(&cinfo);
   const long oh = cinfo.output_height, ow = cinfo.output_width;
-  if (oh <= 0 || ow <= 0 || oh * ow > kMaxPixels) {
+  if (oh <= 0 || ow <= 0 || (scratch && oh * ow > kMaxPixels)) {
     jpeg_abort_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
     return -1;
