@@ -1037,15 +1037,21 @@ _topk_schema = register("topk", _topk_compute,
                                 "dtype": Param("dtype", "float32")})
 _topk_schema.num_outputs = _topk_noutputs
 
-# shape-only ops
+# shape-only ops (reference dtype is int64; under jax's default x64-off
+# mode that maps to int32 — request it directly instead of triggering the
+# truncation warning on every call)
+def _shape_dtype():
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def _shape_array(attrs, octx, x):
-    return _t(jnp.asarray(x.shape, dtype=jnp.int64))
+    return _t(jnp.asarray(x.shape, dtype=_shape_dtype()))
 
 register("shape_array", _shape_array)
 
 
 def _size_array(attrs, octx, x):
-    return _t(jnp.asarray([x.size], dtype=jnp.int64))
+    return _t(jnp.asarray([x.size], dtype=_shape_dtype()))
 
 register("size_array", _size_array)
 

@@ -174,3 +174,106 @@ def test_namespace_alias_parity():
     want = c.asnumpy().copy()
     want[1:3, 1:3] = 0
     np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
+
+
+def test_shape_size_argminlike_ops():
+    av = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(av)
+    np.testing.assert_array_equal(nd.shape_array(a).asnumpy(), [3, 4])
+    np.testing.assert_array_equal(nd.size_array(a).asnumpy(), [12])
+    np.testing.assert_array_equal(nd.argmin(a, axis=1).asnumpy(),
+                                  av.argmin(1))
+    np.testing.assert_allclose(nd.cumsum(a, axis=1).asnumpy(),
+                               av.cumsum(1), rtol=1e-6)
+    np.testing.assert_allclose(nd.nanprod(a + 1).asnumpy(),
+                               np.nanprod(av + 1), rtol=1e-5)
+    np.testing.assert_allclose(nd.degrees(a).asnumpy(), np.degrees(av),
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.radians(a).asnumpy(), np.radians(av),
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.logical_not(a).asnumpy(),
+                               (av == 0).astype(np.float32), rtol=1e-6)
+
+
+def test_like_family_ops():
+    av = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(av)
+    out = nd.broadcast_like(nd.array(np.ones((1, 4), np.float32)), a)
+    assert out.shape == (3, 4)
+    out = nd.reshape_like(a, nd.array(np.zeros((4, 3), np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), av.reshape(4, 3), rtol=1e-6)
+    out = nd.slice_like(a, nd.array(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), av[:2, :2], rtol=1e-6)
+    idx = nd.array(np.array([1, 0, 3], np.float32))
+    np.testing.assert_allclose(nd.batch_take(a, idx).asnumpy(),
+                               av[np.arange(3), [1, 0, 3]], rtol=1e-6)
+
+
+def test_make_loss_and_grad_add():
+    av = np.linspace(0.1, 1.0, 6).astype(np.float32).reshape(2, 3)
+    a = nd.array(av)
+    a.attach_grad()
+    with autograd.record():
+        loss = nd.make_loss(nd.sum(a * a))
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * av, rtol=1e-5)
+    b = nd.array(av)
+    np.testing.assert_allclose(nd._grad_add(a, b).asnumpy(), 2 * av,
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        nd._identity_with_attr_like_rhs(a, b).asnumpy(), av, rtol=1e-6)
+
+
+def test_svm_output_forward_grad():
+    # SVMOutput: forward = identity; backward = hinge-loss gradient
+    # (reference src/operator/svm_output.cc; margin 1, regularization c)
+    sv = np.array([[2.0, -1.0, 0.5], [0.2, 0.9, -0.3]], np.float32)
+    x = nd.array(sv)
+    lab = nd.array(np.array([0, 2], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, lab, margin=1.0, regularization_coefficient=1.0)
+        s = nd.sum(y)
+    np.testing.assert_allclose(y.asnumpy(), sv, rtol=1e-6)
+    s.backward()
+    g = x.grad.asnumpy()
+    assert g.shape == sv.shape
+    # the true-class columns must be pulled UP (negative gradient) wherever
+    # any margin is violated, and violating wrong classes pushed down —
+    # check signs per element against the hinge margin condition
+    for i, lbl in enumerate([0, 2]):
+        for j in range(3):
+            violated = j != lbl and sv[i, j] - sv[i, lbl] + 1.0 > 0
+            if j == lbl:
+                assert g[i, j] <= 0
+            elif violated:
+                assert g[i, j] > 0
+            else:
+                assert g[i, j] == 0
+
+
+def test_linalg_extended_ops():
+    rng = np.random.RandomState(0)
+    m = rng.normal(size=(3, 3)).astype(np.float32)
+    spd = m @ m.T + 3 * np.eye(3, dtype=np.float32)
+    # potri: inverse from cholesky factor
+    import numpy.linalg as la
+    chol = la.cholesky(spd).astype(np.float32)
+    inv = nd._linalg_potri(nd.array(chol)).asnumpy()
+    np.testing.assert_allclose(inv, la.inv(spd), rtol=1e-3, atol=1e-4)
+    # syrk: A @ A.T
+    a = rng.normal(size=(2, 4)).astype(np.float32)
+    np.testing.assert_allclose(nd._linalg_syrk(nd.array(a)).asnumpy(),
+                               a @ a.T, rtol=1e-5)
+    # trmm: triangular matrix multiply (lower, left): A @ B
+    tri = np.tril(rng.normal(size=(3, 3))).astype(np.float32)
+    b = rng.normal(size=(3, 2)).astype(np.float32)
+    np.testing.assert_allclose(
+        nd._linalg_trmm(nd.array(tri), nd.array(b)).asnumpy(),
+        tri @ b, rtol=1e-5)
+
+
+def test_arange_eye_init_ops():
+    np.testing.assert_allclose(nd._arange(start=2, stop=8, step=2).asnumpy(),
+                               [2, 4, 6], rtol=1e-6)
+    np.testing.assert_allclose(nd._eye(N=3).asnumpy(), np.eye(3), rtol=1e-6)
