@@ -24,6 +24,7 @@ def main():
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--embed", type=int, default=32)
     ap.add_argument("--vocab", type=int, default=30)
+    ap.add_argument("--ctx", default="cpu", choices=["cpu", "tpu", "gpu"])
     args = ap.parse_args()
 
     # synthetic corpus: arithmetic sequences modulo vocab, mixed lengths
@@ -53,7 +54,8 @@ def main():
         return (mx.sym.SoftmaxOutput(pred, lab, name="softmax"),
                 ("data",), ("softmax_label",))
 
-    mod = mx.mod.BucketingModule(sym_gen,
+    ctx = getattr(mx, args.ctx)()
+    mod = mx.mod.BucketingModule(sym_gen, context=ctx,
                                  default_bucket_key=train.default_bucket_key)
     mod.fit(train, num_epoch=args.epochs, optimizer="adam",
             optimizer_params={"learning_rate": 3e-3},

@@ -117,9 +117,13 @@ class AGVar:
         self.value = value
 
 
-def _record(schema, attrs, rng, is_train, inputs, outputs, n_out):
+def _record(schema, attrs, rng, is_train, inputs, outputs, n_out,
+            platform=None):
     from .imperative import jitted_for_schema
-    base = jitted_for_schema(schema, attrs, is_train)
+    # same platform as the forward dispatch: the replay must reuse the
+    # forward's compiled executable (cache key includes platform) and
+    # backend-specialized ops must not diverge between fwd and bwd
+    base = jitted_for_schema(schema, attrs, is_train, platform=platform)
     _record_fn(base, inputs, outputs, n_out=n_out,
                rng=rng if schema.needs_rng else None)
 

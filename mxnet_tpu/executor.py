@@ -25,7 +25,7 @@ from .ops.registry import OpCtx
 __all__ = ["Executor"]
 
 
-def _build_runner(symbol, is_train, group2dev=None):
+def _build_runner(symbol, is_train, group2dev=None, platform=None):
     """Emit run(arg_values: tuple, aux_values: tuple, rng) ->
     (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller.
 
@@ -62,7 +62,14 @@ def _build_runner(symbol, is_train, group2dev=None):
             parsed = node.op.parse_attrs(node.attrs)
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
-            octx = OpCtx(is_train=is_train, rng=key)
+            # ctx_group nodes run on THEIR group's device: platform follows
+            # it so backend-specialized ops dispatch for the right target
+            node_platform = platform
+            if group2dev:
+                grp_dev = group2dev.get(node.user_attrs.get("ctx_group"))
+                if grp_dev is not None:
+                    node_platform = grp_dev.platform
+            octx = OpCtx(is_train=is_train, rng=key, platform=node_platform)
             res = node.op.fcompute(parsed, octx, *ins)
             if not isinstance(res, tuple):
                 res = (res,)
@@ -290,8 +297,9 @@ class Executor:
             outputs, new_aux = self._forward_train(rng)
         else:
             if self._jit_eval is None:
-                run_eval = _build_runner(self._symbol, False,
-                                         group2dev=self._group2dev)
+                run_eval = _build_runner(
+                    self._symbol, False, group2dev=self._group2dev,
+                    platform=self._ctx.jax_device().platform)
                 # group2ctx: eager segmented execution (in-jit device_put
                 # is a no-op; see _build_train_fns)
                 self._jit_eval = run_eval if self._group2dev \
@@ -309,7 +317,8 @@ class Executor:
         shapes). Built once: the round-1 design re-ran jax.vjp per batch,
         re-tracing the whole graph every step (VERDICT weak #3)."""
         run = _build_runner(self._symbol, True,
-                            group2dev=self._group2dev)
+                            group2dev=self._group2dev,
+                            platform=self._ctx.jax_device().platform)
         n_args = len(self._arg_names)
         diff_pos = [i for i, n in enumerate(self._arg_names)
                     if self._grad_req.get(n, "null") != "null"]
@@ -415,8 +424,10 @@ class Executor:
             parsed = node.op.parse_attrs(node.attrs)
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
-            res = node.op.fcompute(parsed, OpCtx(is_train=is_train, rng=key),
-                                   *ins)
+            res = node.op.fcompute(
+                parsed, OpCtx(is_train=is_train, rng=key,
+                              platform=self._ctx.jax_device().platform),
+                *ins)
             if not isinstance(res, tuple):
                 res = (res,)
             n_out = node.num_outputs()

@@ -395,11 +395,13 @@ class HybridBlock(Block):
                       for n in aux_n]
 
         is_train = autograd.is_training()
-        key = (id(out), is_train,
+        ctx = _first_ctx(args)
+        platform = ctx.jax_device().platform
+        key = (id(out), is_train, platform,
                tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays))
         run = self._cached_run.get(key)
         if run is None:
-            base = _build_runner(out, is_train)
+            base = _build_runner(out, is_train, platform=platform)
             n_args = len(arg_arrays)
 
             def flat(*arrays):

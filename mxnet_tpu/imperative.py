@@ -28,18 +28,24 @@ def _num_outputs(schema: OpSchema, attrs) -> int:
     return n(attrs) if callable(n) else n
 
 
-def jitted_for_schema(schema: OpSchema, attrs, is_train: bool):
-    """One compiled executable per (op, attrs, is_train); jax caches on avals."""
-    key = (schema.name, attrs.frozen(), bool(is_train))
+def jitted_for_schema(schema: OpSchema, attrs, is_train: bool,
+                      platform=None):
+    """One compiled executable per (op, attrs, is_train, platform); jax
+    caches on avals. `platform` is the dispatch device's platform so
+    backend-specialized fcomputes (pallas) trace the right path."""
+    key = (schema.name, attrs.frozen(), bool(is_train), platform)
     fn = _JIT_CACHE.get(key)
     if fn is None:
         if schema.needs_rng:
             def raw(rng, *inputs):
-                return schema.fcompute(attrs, OpCtx(is_train=is_train, rng=rng),
-                                       *inputs)
+                return schema.fcompute(
+                    attrs, OpCtx(is_train=is_train, rng=rng,
+                                 platform=platform), *inputs)
         else:
             def raw(*inputs):
-                return schema.fcompute(attrs, OpCtx(is_train=is_train), *inputs)
+                return schema.fcompute(
+                    attrs, OpCtx(is_train=is_train, platform=platform),
+                    *inputs)
         fn = jax.jit(raw)
         _JIT_CACHE[key] = fn
     return fn
@@ -94,7 +100,6 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
     if is_train is None:
         is_train = autograd.is_training()
 
-    fn = jitted_for_schema(schema, attrs, is_train)
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
     datas = _reconcile_mesh(datas)
     rng = _random.next_key() if schema.needs_rng else None
@@ -112,6 +117,8 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
             if len(ds) == 1:
                 run_dev = next(iter(ds))
             break
+    platform = run_dev.platform if run_dev is not None else         (ctx.jax_device().platform if ctx is not None else None)
+    fn = jitted_for_schema(schema, attrs, is_train, platform=platform)
 
     def _call():
         if run_dev is not None:
@@ -149,7 +156,8 @@ def invoke(schema: OpSchema, inputs, kwargs, out=None, is_train=None,
     # gradient depends on its aux state — e.g. IdentityAttachKLSparseReg's
     # EMA — would otherwise replay against a double-updated buffer)
     if autograd.is_recording():
-        autograd._record(schema, attrs, rng, is_train, inputs, outputs, n_out)
+        autograd._record(schema, attrs, rng, is_train, inputs, outputs,
+                         n_out, platform=platform)
 
     # auxiliary-state write-back (BatchNorm moving stats): emulates the
     # reference's in-place aux mutation by rebinding the aux NDArray's buffer

@@ -62,7 +62,8 @@ def load_checkpoint(prefix, epoch):
 def _create_kvstore(kvstore, num_device, arg_params):
     """Decide (kvstore instance, update_on_kvstore) — model.py:58."""
     from . import kvstore as kvs
-    update_on_kvstore = True
+    from . import config
+    update_on_kvstore = bool(config.get("MXNET_UPDATE_ON_KVSTORE"))
     if kvstore is None:
         kv = None
     elif isinstance(kvstore, kvs.KVStore):

@@ -122,7 +122,7 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
     return out.reshape(b, h, s, d)
 
 
-def _pallas_eligible(q, k):
+def _pallas_eligible(q, k, platform=None):
     b, h, s, d = q.shape
     if k.shape != q.shape:
         return False          # cross-attention: XLA path handles s_q != s_k
@@ -132,6 +132,8 @@ def _pallas_eligible(q, k):
         return False
     if s < 8:
         return False
+    if platform is not None:
+        return platform not in ("cpu",)
     try:
         return jax.default_backend() not in ("cpu",)
     except Exception:
@@ -162,11 +164,15 @@ def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):
     return fn(q, k, v)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, force=None):
+def flash_attention(q, k, v, causal=False, scale=None, force=None,
+                    platform=None):
     """Blockwise attention: Pallas kernel on TPU, fused XLA otherwise.
 
     force: None (auto) | 'pallas' | 'xla' | 'interpret' (kernel under the
-    Pallas interpreter — CPU-testable).
+    Pallas interpreter — CPU-testable). `platform` is the jit target's
+    platform when the caller compiles for a specific device (the executor
+    plumbs it via OpCtx); auto mode must not pick the pallas path for a
+    cpu-targeted program just because the DEFAULT backend is a TPU.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -175,7 +181,8 @@ def flash_attention(q, k, v, causal=False, scale=None, force=None):
     if force == "interpret":
         return _flash_pallas_trainable(q, k, v, causal, scale,
                                        interpret=True)
-    if force == "pallas" or (force is None and _pallas_eligible(q, k)):
+    if force == "pallas" or (force is None and
+                             _pallas_eligible(q, k, platform)):
         return _flash_pallas_trainable(q, k, v, causal, scale)
     return reference_attention(q, k, v, causal, scale)
 
@@ -184,7 +191,8 @@ def flash_attention(q, k, v, causal=False, scale=None, force=None):
 
 def _flash_attention_op(attrs, octx, q, k, v):
     return _t(flash_attention(q, k, v, causal=attrs["causal"],
-                              scale=attrs["scale"]))
+                              scale=attrs["scale"],
+                              platform=octx.platform))
 
 
 register("_contrib_flash_attention", _flash_attention_op,

@@ -101,6 +101,10 @@ class OpCtx:
     """
     is_train: bool = False
     rng: object = None
+    # target platform ("cpu"/"tpu") when the caller compiles for a specific
+    # device — backend-specialized ops (pallas kernels) must not key off
+    # jax.default_backend(), which may differ from the jit target
+    platform: str = None
 
 
 @dataclasses.dataclass

@@ -104,7 +104,8 @@ class DataParallelTrainer:
         attr_kwargs.update(hp)
         attrs = schema.parse_attrs(attr_kwargs)
 
-        run = _build_runner(symbol, is_train=True)
+        run = _build_runner(symbol, is_train=True,
+                            platform=mesh.devices.flat[0].platform)
         n_args = len(arg_names)
         param_pos = list(self._param_pos)
         input_pos = list(self._input_pos)

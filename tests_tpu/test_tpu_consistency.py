@@ -143,3 +143,141 @@ def test_extra_ops_consistency():
             mx.nd.array(acts, ctx=ctx),
             mx.nd.array(labels, ctx=ctx)).asnumpy())
     assert_almost_equal(louts[0], louts[1], rtol=1e-4, atol=1e-4)
+
+
+def _v(name="data"):
+    return mx.sym.Variable(name)
+
+
+# broad per-family sweep (role of test_operator_gpu re-running the op suite
+# under the accelerator): each case is (id, symbol builder, shapes, rtol,
+# atol). Shapes stay small — every case compiles fwd+bwd on both backends.
+_SWEEP = [
+    ("unary_chain",
+     lambda: mx.sym.arctan(mx.sym.softsign(_v()) + mx.sym.erf(_v() * 0.5)),
+     {"data": (3, 7)}, ELEMWISE_RTOL, 1e-4),
+    ("unary_log_exp",
+     lambda: mx.sym.log1p(mx.sym.exp(_v() * 0.3)) + mx.sym.expm1(_v() * 0.1),
+     {"data": (4, 5)}, ELEMWISE_RTOL, 1e-4),
+    ("binary_broadcast",
+     lambda: mx.sym.broadcast_maximum(
+         mx.sym.broadcast_add(_v(), mx.sym.Variable("b")),
+         mx.sym.broadcast_mul(_v(), mx.sym.Variable("b"))),
+     {"data": (3, 1, 4), "b": (1, 2, 4)}, ELEMWISE_RTOL, 1e-4),
+    ("reductions",
+     lambda: mx.sym.sum(_v(), axis=1) + mx.sym.mean(_v(), axis=1) +
+     mx.sym.max(_v(), axis=1) + mx.sym.min(_v(), axis=1),
+     {"data": (5, 6)}, 1e-4, 1e-4),
+    ("dot_transpose",
+     lambda: mx.sym.dot(_v(), mx.sym.transpose(mx.sym.Variable("b"))),
+     {"data": (4, 6), "b": (5, 6)}, MXU_RTOL, MXU_ATOL),
+    ("batch_dot",
+     lambda: mx.sym.batch_dot(_v(), mx.sym.Variable("b")),
+     {"data": (2, 3, 4), "b": (2, 4, 5)}, MXU_RTOL, MXU_ATOL),
+    ("matrix_ops",
+     lambda: mx.sym.reverse(mx.sym.tile(mx.sym.slice(
+         _v(), begin=(0, 1), end=(3, 4)), reps=(1, 2)), axis=1),
+     {"data": (3, 5)}, ELEMWISE_RTOL, 1e-5),
+    ("indexing_take",
+     lambda: mx.sym.take(_v(), mx.sym.floor(
+         mx.sym.abs(mx.sym.Variable("idx")) * 2), axis=0),
+     {"data": (5, 3), "idx": (4,)}, ELEMWISE_RTOL, 1e-4),
+    ("one_hot_embed",
+     lambda: mx.sym.Embedding(mx.sym.abs(mx.sym.round(
+         mx.sym.Variable("idx") * 2)), input_dim=6, output_dim=4,
+         name="emb"),
+     {"idx": (3, 2)}, ELEMWISE_RTOL, 1e-4),
+    ("ordering_topk",
+     lambda: mx.sym.topk(_v(), k=3, ret_typ="value", axis=1),
+     {"data": (4, 8)}, ELEMWISE_RTOL, 1e-5),
+    ("argsort_argmax",
+     lambda: mx.sym.argsort(_v(), axis=1) + mx.sym.argmax(
+         _v(), axis=1, keepdims=True),
+     {"data": (3, 6)}, 1e-6, 1e-6),
+    ("linalg_gemm2_potrf",
+     lambda: mx.sym._linalg_gemm2(_v(), _v(), transpose_b=True),
+     {"data": (3, 4)}, MXU_RTOL, MXU_ATOL),
+    ("layernorm",
+     lambda: mx.sym.LayerNorm(_v(), mx.sym.Variable("g"),
+                              mx.sym.Variable("be"), axis=-1),
+     {"data": (4, 6), "g": (6,), "be": (6,)}, 1e-3, 1e-3),
+    ("instancenorm_l2norm",
+     lambda: mx.sym.L2Normalization(mx.sym.InstanceNorm(
+         _v(), mx.sym.Variable("g"), mx.sym.Variable("be"))),
+     {"data": (2, 3, 4, 4), "g": (3,), "be": (3,)}, 1e-3, 1e-3),
+    ("lrn",
+     lambda: mx.sym.LRN(_v(), nsize=3),
+     {"data": (2, 5, 4, 4)}, 1e-3, 1e-3),
+    ("deconv",
+     lambda: mx.sym.Deconvolution(_v(), kernel=(3, 3), num_filter=2,
+                                  name="dc"),
+     {"data": (1, 3, 5, 5)}, MXU_RTOL, MXU_ATOL),
+    ("depthwise_conv",
+     lambda: mx.sym.Convolution(_v(), kernel=(3, 3), num_filter=4,
+                                num_group=4, pad=(1, 1), name="dw"),
+     {"data": (1, 4, 6, 6)}, MXU_RTOL, MXU_ATOL),
+    ("conv1d_3d",
+     lambda: mx.sym.Convolution(_v(), kernel=(3,), num_filter=2,
+                                name="c1"),
+     {"data": (2, 3, 8)}, MXU_RTOL, MXU_ATOL),
+    ("upsampling_pad",
+     lambda: mx.sym.Pad(mx.sym.UpSampling(
+         _v(), scale=2, sample_type="nearest"), mode="edge",
+         pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     {"data": (1, 2, 3, 3)}, ELEMWISE_RTOL, 1e-5),
+    ("leaky_prelu",
+     lambda: mx.sym.LeakyReLU(_v(), act_type="prelu",
+                              gamma=mx.sym.Variable("g"), name="pr"),
+     {"data": (3, 4), "g": (4,)}, ELEMWISE_RTOL, 1e-5),
+    ("elu_selu_gelu",
+     lambda: mx.sym.LeakyReLU(_v(), act_type="elu") +
+     mx.sym.Activation(_v(), act_type="softrelu"),
+     {"data": (3, 5)}, 1e-4, 1e-4),
+    ("sequence_ops",
+     lambda: mx.sym.SequenceReverse(mx.sym.SequenceMask(
+         _v(), use_sequence_length=False)),
+     {"data": (4, 2, 3)}, ELEMWISE_RTOL, 1e-6),
+    ("roipooling",
+     lambda: mx.sym.ROIPooling(_v(), mx.sym.Variable("rois"),
+                               pooled_size=(2, 2), spatial_scale=1.0),
+     {"data": (1, 2, 6, 6), "rois": (2, 5)}, 1e-4, 1e-4),
+    ("bilinear_resize",
+     lambda: mx.sym.contrib.BilinearResize2D(_v(), height=6, width=6),
+     {"data": (1, 2, 4, 4)}, 1e-4, 1e-4),
+    ("adaptive_avg_pool",
+     lambda: mx.sym.contrib.AdaptiveAvgPooling2D(_v(), output_size=(2, 2)),
+     {"data": (1, 3, 6, 6)}, MXU_RTOL, MXU_ATOL),
+    ("grid_bilinear_sampler",
+     lambda: mx.sym.BilinearSampler(_v(), mx.sym.GridGenerator(
+         mx.sym.Variable("aff"), transform_type="affine",
+         target_shape=(4, 4))),
+     {"data": (1, 2, 4, 4), "aff": (1, 6)}, 5e-2, 5e-2),
+    ("swapaxis_flip_clip",
+     lambda: mx.sym.clip(mx.sym.SwapAxis(_v(), dim1=1, dim2=2), -0.5, 0.5),
+     {"data": (2, 3, 4)}, ELEMWISE_RTOL, 1e-6),
+    ("where_mask",
+     lambda: mx.sym.where(mx.sym.broadcast_greater(
+         _v(), mx.sym.zeros(shape=(3, 4))), _v(), _v() * 0.1),
+     {"data": (3, 4)}, ELEMWISE_RTOL, 1e-6),
+    ("gather_scatter_nd",
+     lambda: mx.sym.gather_nd(_v(), mx.sym.abs(mx.sym.round(
+         mx.sym.Variable("idx")))),
+     {"data": (4, 3), "idx": (1, 2)}, ELEMWISE_RTOL, 1e-5),
+    ("fused_rnn_lstm",
+     lambda: mx.sym.RNN(_v(), mx.sym.Variable("p"), mx.sym.Variable("s0"),
+                        mx.sym.Variable("s1"), state_size=4, num_layers=1,
+                        mode="lstm", name="rnn"),
+     {"data": (3, 2, 5), "p": (4 * 4 * (5 + 4 + 2),), "s0": (1, 2, 4),
+      "s1": (1, 2, 4)}, MXU_RTOL, MXU_ATOL),
+    ("flash_attention_op",
+     lambda: mx.sym.contrib.flash_attention(
+         _v("q"), _v("k"), _v("v"), causal=True),
+     {"q": (1, 2, 128, 128), "k": (1, 2, 128, 128),
+      "v": (1, 2, 128, 128)}, 5e-3, 5e-2),
+]
+
+
+@pytest.mark.parametrize("case", _SWEEP, ids=[c[0] for c in _SWEEP])
+def test_family_sweep_consistency(case):
+    _, builder, shapes, rtol, atol = case
+    check_consistency(builder(), _pair(shapes), rtol=rtol, atol=atol)
