@@ -97,7 +97,8 @@ def lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("MXNET_TPU_DISABLE_NATIVE"):
+        from .. import config
+        if config.flag("MXNET_TPU_DISABLE_NATIVE"):
             return None
         try:
             path = _compile()

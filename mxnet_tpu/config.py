@@ -55,6 +55,11 @@ _DOCUMENTED = {
     "MXNET_DUMP_PROFILE": 0,
     "MXNET_BACKWARD_DO_MIRROR": 0,
     "MXNET_USE_FUSION": 1,
+    # native-runtime knobs (TPU build additions, docs/env_vars.md)
+    "MXNET_TPU_DISABLE_NATIVE": 0,
+    "MXNET_TPU_DISABLE_NATIVE_ITER": 0,
+    "MXNET_TPU_NATIVE_DIR": None,
+    "MXIO_PIPE_DEBUG": 0,
 }
 
 
@@ -71,6 +76,13 @@ def get(name, default=None):
         except ValueError:
             return default
     return raw
+
+
+def flag(name):
+    """Boolean env flag with forgiving parsing: unset/''/'0'/'false' are
+    False (plain truthiness would treat the string '0' as enabled)."""
+    return os.environ.get(name, "") not in ("", "0", "false", "False",
+                                            "off", "no")
 
 
 def list_vars():

@@ -401,6 +401,8 @@ class Executor:
         executor monitor callback, graph_executor.cc:1451)."""
         from .ndarray.ndarray import NDArray
         symbol = self._symbol
+        base_platform = self._ctx.jax_device().platform
+        group2dev = self._group2dev
         topo = symbol._topo()
         args_n, aux_n = symbol._input_vars()
         arg_index = {id(n): i for i, n in enumerate(args_n)}
@@ -424,9 +426,14 @@ class Executor:
             parsed = node.op.parse_attrs(node.attrs)
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
+            node_platform = base_platform
+            if group2dev:
+                grp_dev = group2dev.get(node.user_attrs.get("ctx_group"))
+                if grp_dev is not None:
+                    node_platform = grp_dev.platform
             res = node.op.fcompute(
                 parsed, OpCtx(is_train=is_train, rng=key,
-                              platform=self._ctx.jax_device().platform),
+                              platform=node_platform),
                 *ins)
             if not isinstance(res, tuple):
                 res = (res,)

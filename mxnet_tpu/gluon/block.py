@@ -384,18 +384,16 @@ class HybridBlock(Block):
         param_map = {p.name: p for _, p in self.collect_params().items()}
         input_map = {inp.name: a for inp, a in zip(inputs, flat_args)}
 
+        ctx = _first_ctx(args)
         arg_arrays = []
         for n in args_n:
             if n.name in input_map:
                 arg_arrays.append(input_map[n.name])
             else:
-                arg_arrays.append(param_map[n.name].data(
-                    _first_ctx(args)))
-        aux_arrays = [param_map[n.name].data(_first_ctx(args))
-                      for n in aux_n]
+                arg_arrays.append(param_map[n.name].data(ctx))
+        aux_arrays = [param_map[n.name].data(ctx) for n in aux_n]
 
         is_train = autograd.is_training()
-        ctx = _first_ctx(args)
         platform = ctx.jax_device().platform
         key = (id(out), is_train, platform,
                tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays))

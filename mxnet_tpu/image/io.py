@@ -222,7 +222,8 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, prefetch_buffer=2,
     aug_keys = {k for k, v in kwargs.items()
                 if k not in _pass_keys + ("path_imgidx", "round_batch")
                 and _has_effect(v)}
-    if (not os.environ.get("MXNET_TPU_DISABLE_NATIVE_ITER")
+    from .. import config
+    if (not config.flag("MXNET_TPU_DISABLE_NATIVE_ITER")
             and _native.has_jpeg()
             and tuple(data_shape)[0] == 3
             and kwargs.get("round_batch", True)

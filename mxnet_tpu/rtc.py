@@ -48,7 +48,7 @@ class PallasKernel:
         """
         import jax
         import jax.experimental.pallas as pl
-        from .ndarray.ndarray import NDArray, array
+        from .ndarray.ndarray import NDArray
 
         if len(arrays) != self._num_inputs:
             raise MXNetError(
@@ -70,7 +70,9 @@ class PallasKernel:
             kwargs["grid"] = grid
         call = pl.pallas_call(self._fn, **kwargs)
         res = call(*vals)
-        return array(res) if not isinstance(res, NDArray) else res
+        # wrap WITHOUT re-committing: array() would copy the result to the
+        # default (cpu) context; the kernel output stays on its device
+        return res if isinstance(res, NDArray) else NDArray(res)
 
 
 class PallasModule:

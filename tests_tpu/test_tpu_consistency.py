@@ -281,3 +281,56 @@ _SWEEP = [
 def test_family_sweep_consistency(case):
     _, builder, shapes, rtol, atol = case
     check_consistency(builder(), _pair(shapes), rtol=rtol, atol=atol)
+
+
+def test_rtc_kernel_output_stays_on_device():
+    rng = np.random.RandomState(0)
+    mod = mx.rtc.PallasModule(
+        "def mul2(x_ref, o_ref):\n    o_ref[:] = x_ref[:] * 2.0\n")
+    k = mod.get_kernel("mul2", num_inputs=1)
+    a = mx.nd.array(rng.normal(size=(2, 128)).astype(np.float32),
+                    ctx=mx.tpu(0))
+    out = k.launch(a)
+    assert "cpu" not in str(out.context).lower()
+    assert_almost_equal(out.asnumpy(), a.asnumpy() * 2.0, rtol=1e-6)
+    # cpu-context arrays run under the interpreter and stay on cpu
+    b = mx.nd.array(rng.normal(size=(2, 128)).astype(np.float32),
+                    ctx=mx.cpu())
+    out_cpu = k.launch(b)
+    assert "cpu" in str(out_cpu.context).lower()
+
+
+def test_native_iter_feeds_module_on_chip(tmp_path):
+    """Regression lane for the pipeline deadlock: the native C++ iterator
+    feeding Module.fit on the real chip (slow axon init exposed the
+    claim-before-buffer worker deadlock)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image.io import ImageRecordIter, _NativeImageRecordIter
+    from mxnet_tpu import _native
+    if not _native.has_jpeg():
+        pytest.skip("native lib built without libjpeg")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "c.idx"),
+                                     str(tmp_path / "c.rec"), "w")
+    for i in range(32):
+        base = 40 if i % 2 == 0 else 180
+        img = (base + rng.randint(0, 20, (32, 32, 3))).clip(
+            0, 255).astype(np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img))
+    rec.close()
+    it = ImageRecordIter(str(tmp_path / "c.rec"), (3, 28, 28), 8,
+                         shuffle=True, rand_crop=True, mean=128.0, std=64.0,
+                         preprocess_threads=2, seed=3)
+    assert isinstance(it, _NativeImageRecordIter)
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(mx.sym.Variable("data")), num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9
+    it.close()
