@@ -281,9 +281,13 @@ def _replay_executable(node_list, var_index, node_index, head_specs):
         return vjp_fn(tuple(head_grads))
 
     jitted = jax.jit(vjp_replay)
-    if len(_REPLAY_CACHE) >= _REPLAY_CACHE_MAX:
-        _REPLAY_CACHE.pop(next(iter(_REPLAY_CACHE)))
-    _REPLAY_CACHE[key] = (jitted,)
+    # tapes containing per-call closures (autograd.Function) can never hit
+    # the cache again (fn identity is the key): keep them out so they do
+    # not evict the stable entries training loops rely on
+    if not any(getattr(fn, "_mx_uncached_replay", False) for fn in fns):
+        if len(_REPLAY_CACHE) >= _REPLAY_CACHE_MAX:
+            _REPLAY_CACHE.pop(next(iter(_REPLAY_CACHE)))
+        _REPLAY_CACHE[key] = (jitted,)
     return jitted, dyn_specs, rng_nodes
 
 
@@ -395,3 +399,90 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
 def get_symbol(x):
     raise MXNetError("autograd.get_symbol is not supported; use "
                      "Gluon HybridBlock tracing instead")
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable functions — mx.autograd.Function (autograd.py:383)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined differentiable NDArray function.
+
+    Subclass and implement ``forward(self, *inputs)`` (NDArrays in,
+    NDArray or tuple out) and ``backward(self, *output_grads)``
+    (NDArrays of head gradients in, per-input gradient NDArrays out);
+    call the instance. Both run as host callbacks (``jax.pure_callback``)
+    inside the recorded graph, so the tape replay stays one compiled
+    program. Same device note as mx.operator.CustomOp: host callbacks
+    need PJRT send/recv — run on mx.cpu() under the axon dev tunnel.
+
+    Cost model: ``forward`` executes once eagerly at call time (to learn
+    output shapes/dtypes) and again inside the replayed program when
+    ``backward()`` runs, and each call records a fresh closure, so every
+    backward over a Function-bearing tape re-traces — this is the slow
+    escape-hatch path, like the reference's custom-op engine lane.
+
+    Reference: python/mxnet/autograd.py:383 (Function over
+    MXCustomFunctionRecord).
+    """
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        import jax
+
+        vals = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                for x in inputs]
+        in_avals = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for v in vals)
+        fn_self = self
+
+        # learn output avals by running forward once, eagerly (host)
+        with pause():
+            eager = fn_self.forward(*[NDArray(v) for v in vals])
+        single = not isinstance(eager, (list, tuple))
+        eager_list = [eager] if single else list(eager)
+        out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o._data.dtype)
+                          for o in eager_list)
+
+        if not is_recording():
+            return eager if single else tuple(eager_list)
+
+        def _host_fwd(*vs):
+            with pause():
+                res = fn_self.forward(*[NDArray(jnp.asarray(v))
+                                        for v in vs])
+            res = [res] if not isinstance(res, (list, tuple)) else res
+            return tuple(_np.asarray(r.asnumpy(), dtype=a.dtype)
+                         for r, a in zip(res, out_avals))
+
+        def _host_bwd(*args):
+            gs = args[len(in_avals):]
+            with pause():
+                grads = fn_self.backward(*[NDArray(jnp.asarray(g))
+                                           for g in gs])
+            grads = [grads] if not isinstance(grads, (list, tuple)) \
+                else grads
+            return tuple(_np.asarray(g.asnumpy(), dtype=a.dtype)
+                         for g, a in zip(grads, in_avals))
+
+        @jax.custom_vjp
+        def f(*vs):
+            return jax.pure_callback(_host_fwd, out_avals, *vs)
+
+        def fwd(*vs):
+            return f(*vs), vs
+
+        def bwd(res_vs, gs):
+            return jax.pure_callback(_host_bwd, in_avals, *res_vs, *gs)
+
+        f.defvjp(fwd, bwd)
+        # per-call closure: replay executables containing it are one-shot
+        f._mx_uncached_replay = True
+        _record_fn(f, list(inputs), eager_list, n_out=len(eager_list))
+        return eager if single else tuple(eager_list)

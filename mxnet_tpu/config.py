@@ -79,10 +79,11 @@ def get(name, default=None):
 
 
 def flag(name):
-    """Boolean env flag with forgiving parsing: unset/''/'0'/'false' are
-    False (plain truthiness would treat the string '0' as enabled)."""
-    return os.environ.get(name, "") not in ("", "0", "false", "False",
-                                            "off", "no")
+    """Boolean env flag with forgiving parsing: unset/''/'0'/'false'/'off'/
+    'no' (any case, whitespace ignored) are False — plain truthiness would
+    treat the string '0' as enabled."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
 
 
 def list_vars():

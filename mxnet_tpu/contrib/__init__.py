@@ -5,3 +5,4 @@ Parity target: python/mxnet/contrib/ (SURVEY.md §2.4 "contrib py").
 from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401
+from . import torch_bridge  # noqa: F401

@@ -25,6 +25,13 @@ from .ops.registry import OpCtx
 __all__ = ["Executor"]
 
 
+def _node_group_dev(node, group2dev):
+    """Device for a ctx_group-tagged node, or None (PlaceDevice role)."""
+    if not group2dev:
+        return None
+    return group2dev.get(node.user_attrs.get("ctx_group"))
+
+
 def _build_runner(symbol, is_train, group2dev=None, platform=None):
     """Emit run(arg_values: tuple, aux_values: tuple, rng) ->
     (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller.
@@ -64,20 +71,15 @@ def _build_runner(symbol, is_train, group2dev=None, platform=None):
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
             # ctx_group nodes run on THEIR group's device: platform follows
             # it so backend-specialized ops dispatch for the right target
-            node_platform = platform
-            if group2dev:
-                grp_dev = group2dev.get(node.user_attrs.get("ctx_group"))
-                if grp_dev is not None:
-                    node_platform = grp_dev.platform
+            grp_dev = _node_group_dev(node, group2dev)
+            node_platform = grp_dev.platform if grp_dev is not None \
+                else platform
             octx = OpCtx(is_train=is_train, rng=key, platform=node_platform)
             res = node.op.fcompute(parsed, octx, *ins)
             if not isinstance(res, tuple):
                 res = (res,)
-            if group2dev:
-                grp = node.user_attrs.get("ctx_group")
-                dev = group2dev.get(grp) if grp else None
-                if dev is not None:
-                    res = tuple(jax.device_put(r, dev) for r in res)
+            if grp_dev is not None:
+                res = tuple(jax.device_put(r, grp_dev) for r in res)
             n_out = node.num_outputs()
             vals[pos] = res[:n_out]
             if node.op.mutates_aux and (is_train or node.op.aux_always):
@@ -426,17 +428,19 @@ class Executor:
             parsed = node.op.parse_attrs(node.attrs)
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
-            node_platform = base_platform
-            if group2dev:
-                grp_dev = group2dev.get(node.user_attrs.get("ctx_group"))
-                if grp_dev is not None:
-                    node_platform = grp_dev.platform
+            grp_dev = _node_group_dev(node, group2dev)
+            node_platform = grp_dev.platform if grp_dev is not None \
+                else base_platform
             res = node.op.fcompute(
                 parsed, OpCtx(is_train=is_train, rng=key,
                               platform=node_platform),
                 *ins)
             if not isinstance(res, tuple):
                 res = (res,)
+            if grp_dev is not None:
+                # commit outputs to the group's device (fused-path parity:
+                # the monitored forward must place like _build_runner)
+                res = tuple(jax.device_put(r, grp_dev) for r in res)
             n_out = node.num_outputs()
             vals[pos] = res[:n_out]
             for i in range(n_out):

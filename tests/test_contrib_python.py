@@ -186,3 +186,86 @@ def test_image_det_iter_from_rec(tmp_path):
     assert batch.label[0].shape == (2, 3, 5)
     lab = batch.label[0].asnumpy()
     assert (lab[0, 0] != -1).any()
+
+
+def test_torch_module_trains_inside_record():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.contrib import torch_bridge
+    rng = np.random.RandomState(0)
+    tnet = torch.nn.Linear(6, 1)
+    op = torch_bridge.TorchModule(tnet)
+    Xv = rng.normal(size=(64, 6)).astype(np.float32)
+    w_true = rng.normal(size=(6, 1)).astype(np.float32)
+    yv = Xv @ w_true
+    X = mx.nd.array(Xv)
+    y = mx.nd.array(yv)
+    losses = []
+    for step in range(40):
+        with mx.autograd.record():
+            pred = op(X)
+            loss = mx.nd.mean(mx.nd.square(pred - y))
+        loss.backward()
+        losses.append(float(loss.asnumpy()))
+        op.step(0.1)                     # mxnet owns the torch weights
+    assert losses[-1] < losses[0] * 0.05, losses[::10]
+    # trained values round-trip into the torch module
+    op.sync_to_torch()
+    tout = tnet(torch.from_numpy(Xv)).detach().numpy()
+    np.testing.assert_allclose(tout, op(X).asnumpy(), rtol=1e-5)
+
+
+def test_torch_loss_and_eval_function():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.contrib import torch_bridge
+    rng = np.random.RandomState(1)
+    pv = rng.normal(size=(8, 3)).astype(np.float32)
+    tv = rng.normal(size=(8, 3)).astype(np.float32)
+    p = mx.nd.array(pv)
+    p.attach_grad()
+    crit = torch_bridge.TorchLoss(torch.nn.MSELoss())
+    with mx.autograd.record():
+        loss = crit(p, mx.nd.array(tv))
+    loss.backward()
+    np.testing.assert_allclose(float(loss.asnumpy()),
+                               np.mean((pv - tv) ** 2), rtol=1e-5)
+    np.testing.assert_allclose(p.grad.asnumpy(), 2 * (pv - tv) / pv.size,
+                               rtol=1e-4)
+    out = torch_bridge.eval_function(torch.special.expit, mx.nd.array(pv))
+    np.testing.assert_allclose(out.asnumpy(), 1 / (1 + np.exp(-pv)),
+                               rtol=1e-5)
+
+
+def test_autograd_function_multi_output():
+    class SplitHalf(mx.autograd.Function):
+        def forward(self, x):
+            n = x.shape[0] // 2
+            self._n = n
+            return x[:n] * 2.0, x[n:] * 3.0
+        def backward(self, g1, g2):
+            return mx.nd.concat(g1 * 2.0, g2 * 3.0, dim=0)
+    xv = np.arange(6, dtype=np.float32)
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    f = SplitHalf()
+    with mx.autograd.record():
+        a, b = f(x)
+        loss = mx.nd.sum(a) + mx.nd.sum(b)
+    loss.backward()
+    np.testing.assert_allclose(a.asnumpy(), xv[:3] * 2, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.concatenate([np.full(3, 2.0),
+                                               np.full(3, 3.0)]), rtol=1e-6)
+
+
+def test_torch_embedding_int_inputs():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.contrib import torch_bridge
+    emb = torch.nn.Embedding(10, 4)
+    op = torch_bridge.TorchModule(emb)
+    ids = mx.nd.array(np.array([1, 3, 5], np.int64), dtype="int64")
+    with mx.autograd.record():
+        out = op(ids)
+        loss = mx.nd.sum(out * out)
+    loss.backward()
+    g = op.params[0].grad.asnumpy()
+    assert sorted(np.where(np.abs(g).sum(1) > 0)[0].tolist()) == [1, 3, 5]
