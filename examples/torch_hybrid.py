@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Hybrid mxnet+PyTorch training via mx.contrib.torch_bridge.
+
+Role of the reference's plugin/torch examples: an mxnet convolutional
+feature extractor feeding a torch.nn head, trained jointly — torch
+weights live on the mxnet tape (TorchModule) and a torch criterion
+scores the output (TorchLoss). Host callbacks need PJRT send/recv, so
+this example runs on cpu (see README device note).
+
+  python examples/torch_hybrid.py [--steps 40]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    args = ap.parse_args()
+
+    try:
+        import torch
+    except ImportError:
+        print("pytorch not installed; skipping")
+        return
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import torch_bridge
+    nd = mx.nd
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.normal(size=(64, 1, 8, 8)).astype(np.float32),
+                 ctx=mx.cpu())
+    y = nd.array((rng.normal(size=(64,)) > 0).astype(np.float32).reshape(
+        -1, 1), ctx=mx.cpu())
+
+    w = nd.array(rng.normal(scale=0.2, size=(4, 1, 3, 3)).astype(np.float32),
+                 ctx=mx.cpu())
+    w.attach_grad()
+    head = torch_bridge.TorchModule(torch.nn.Sequential(
+        torch.nn.Linear(4 * 6 * 6, 16), torch.nn.Tanh(),
+        torch.nn.Linear(16, 1)))
+    crit = torch_bridge.TorchLoss(torch.nn.BCEWithLogitsLoss())
+
+    for step in range(args.steps):
+        with mx.autograd.record():
+            f = nd.Activation(nd.Convolution(
+                X, w, no_bias=True, kernel=(3, 3), num_filter=4),
+                act_type="relu")
+            logits = head(nd.Flatten(f))
+            loss = crit(logits, y)
+        loss.backward()
+        head.step(0.1)
+        w -= 0.1 * w.grad
+        w.grad[:] = 0
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {loss.asnumpy().item():.4f}")
+    head.sync_to_torch()
+    print("done; torch head round-tripped")
+
+
+if __name__ == "__main__":
+    main()

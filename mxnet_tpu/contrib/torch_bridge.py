@@ -85,9 +85,16 @@ class TorchModule:
 
     def __call__(self, *inputs):
         from .. import autograd
+        from .. import random as _mx_random
         torch = _torch()
         bridge = self
         n_in = len(inputs)
+        # per-call seed: forward runs twice (eager + backward replay), and
+        # stochastic modules (Dropout) must sample the SAME mask both
+        # times or gradients decouple from the reported output — mirrors
+        # the framework's recorded-rng-key replay discipline
+        call_seed = int(_np.asarray(
+            _mx_random.next_key()).ravel()[0]) & 0x7FFFFFFF
 
         class _Fn(autograd.Function):
             def forward(self, *args):
@@ -99,7 +106,10 @@ class TorchModule:
                     if t.is_floating_point() or t.is_complex():
                         t.requires_grad_(True)
                     tall.append(t)
-                out = bridge._functional(torch, tall[:n_in], tall[n_in:])
+                with torch.random.fork_rng(devices=[]):
+                    torch.manual_seed(call_seed)
+                    out = bridge._functional(torch, tall[:n_in],
+                                             tall[n_in:])
                 self._tall = tall
                 self._tout = out
                 single = torch.is_tensor(out)

@@ -1,0 +1,245 @@
+// Native-side unit tests for src/runtime_native.cc (role of the
+// reference's tests/cpp gtest tier — here a dependency-free assert
+// harness so the image needs no gtest). Build+run via
+// tests/test_native.py::test_cpp_unit_harness:
+//
+//   g++ -O2 -std=c++17 -DMXIO_HAS_JPEG runtime_native_test.cc \
+//       runtime_native.cc -ljpeg -lpthread -o t && ./t
+//
+// Exercises, from C++ (no python in the loop): recordio framing
+// round-trip, 2-bit quantization numerics + error feedback, the CHW
+// conversion kernel, and the threaded pipe's ordering/reset/error
+// behavior against a synthetic JPEG record file.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <vector>
+
+extern "C" {
+long mxio_scan_records(const char*, long*, long*, long);
+int mxio_read_records(const char*, const long*, const long*, long,
+                      unsigned char*);
+void mxio_quantize_2bit(const float*, float*, uint32_t*, long, float);
+void mxio_dequantize_2bit(const uint32_t*, float*, long, float);
+void mxio_hwc_u8_to_chw_f32(const unsigned char*, float*, long, long, long,
+                            const float*, const float*);
+int mxio_has_jpeg();
+int mxio_jpeg_decode(const unsigned char*, long, unsigned char*, long,
+                     long*, long*);
+void* mxio_pipe_create(const char*, const long*, const long*, long, long,
+                       long, long, long, long, int, int, const float*,
+                       const float*, long, long, long, uint64_t);
+int mxio_pipe_reset(void*, const long*, long);
+int mxio_pipe_next(void*, float*, float*, long*);
+void mxio_pipe_destroy(void*);
+}
+
+#if defined(MXIO_HAS_JPEG)
+#include <jpeglib.h>
+#endif
+
+static int g_failures = 0;
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                              \
+      ++g_failures;                                                     \
+    }                                                                   \
+  } while (0)
+
+namespace {
+constexpr uint32_t kMagic = 0xced7230au;
+
+void WriteRec(FILE* fp, const unsigned char* payload, long len) {
+  uint32_t head[2] = {kMagic, static_cast<uint32_t>(len)};
+  std::fwrite(head, sizeof(uint32_t), 2, fp);
+  std::fwrite(payload, 1, len, fp);
+  static const unsigned char pad[4] = {0, 0, 0, 0};
+  std::fwrite(pad, 1, (4 - (len & 3)) & 3, fp);
+}
+
+void TestRecordioRoundTrip(const std::string& dir) {
+  const std::string path = dir + "/t.rec";
+  FILE* fp = std::fopen(path.c_str(), "wb");
+  std::vector<std::vector<unsigned char>> payloads;
+  for (int i = 0; i < 7; ++i) {
+    payloads.emplace_back(5 + 11 * i, static_cast<unsigned char>(i));
+    WriteRec(fp, payloads.back().data(),
+             static_cast<long>(payloads.back().size()));
+  }
+  std::fclose(fp);
+  long n = mxio_scan_records(path.c_str(), nullptr, nullptr, 0);
+  CHECK(n == 7);
+  std::vector<long> offs(n), lens(n);
+  CHECK(mxio_scan_records(path.c_str(), offs.data(), lens.data(), n) == n);
+  long total = 0;
+  for (long i = 0; i < n; ++i) total += lens[i];
+  std::vector<unsigned char> buf(total);
+  CHECK(mxio_read_records(path.c_str(), offs.data(), lens.data(), n,
+                          buf.data()) == 0);
+  long pos = 0;
+  for (long i = 0; i < n; ++i) {
+    CHECK(lens[i] == static_cast<long>(payloads[i].size()));
+    CHECK(std::memcmp(buf.data() + pos, payloads[i].data(), lens[i]) == 0);
+    pos += lens[i];
+  }
+}
+
+void Test2BitNumerics() {
+  const long n = 37;
+  std::vector<float> grad(n), residual(n, 0.0f), out(n);
+  for (long i = 0; i < n; ++i) grad[i] = 0.11f * (i % 7) - 0.3f;
+  std::vector<uint32_t> packed((n + 15) / 16);
+  const float thr = 0.25f;
+  mxio_quantize_2bit(grad.data(), residual.data(), packed.data(), n, thr);
+  mxio_dequantize_2bit(packed.data(), out.data(), n, thr);
+  for (long i = 0; i < n; ++i) {
+    // decode is in {-thr, 0, +thr} and error feedback holds exactly:
+    // residual == grad - decoded
+    CHECK(out[i] == 0.0f || out[i] == thr || out[i] == -thr);
+    CHECK(std::fabs(residual[i] - (grad[i] - out[i])) < 1e-6f);
+  }
+}
+
+void TestChwConversion() {
+  const long h = 3, w = 5, c = 3;
+  std::vector<unsigned char> img(h * w * c);
+  for (size_t i = 0; i < img.size(); ++i)
+    img[i] = static_cast<unsigned char>((i * 7) % 251);
+  const float mean[3] = {1.0f, 2.0f, 3.0f};
+  const float stdinv[3] = {0.5f, 0.25f, 2.0f};
+  std::vector<float> out(c * h * w);
+  mxio_hwc_u8_to_chw_f32(img.data(), out.data(), h, w, c, mean, stdinv);
+  for (long ch = 0; ch < c; ++ch)
+    for (long i = 0; i < h * w; ++i)
+      CHECK(std::fabs(out[ch * h * w + i] -
+                      (static_cast<float>(img[i * c + ch]) - mean[ch]) *
+                          stdinv[ch]) < 1e-5f);
+}
+
+#if defined(MXIO_HAS_JPEG)
+std::vector<unsigned char> EncodeGrayJpeg(int h, int w, int seed) {
+  // encode a smooth RGB image via libjpeg into memory
+  std::vector<unsigned char> rgb(static_cast<size_t>(h) * w * 3);
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x)
+      for (int ch = 0; ch < 3; ++ch)
+        rgb[(static_cast<size_t>(y) * w + x) * 3 + ch] =
+            static_cast<unsigned char>((y * 3 + x * 2 + ch * 40 + seed * 17) %
+                                       256);
+  jpeg_compress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&cinfo);
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, 95, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = rgb.data() + static_cast<size_t>(cinfo.next_scanline) *
+                                    w * 3;
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  std::vector<unsigned char> out(mem, mem + mem_size);
+  std::free(mem);
+  return out;
+}
+
+void TestPipeOrderingAndReset(const std::string& dir) {
+  const std::string path = dir + "/imgs.rec";
+  FILE* fp = std::fopen(path.c_str(), "wb");
+  for (int i = 0; i < 10; ++i) {
+    auto jpg = EncodeGrayJpeg(40, 50, i);
+    // IRHeader: flag=0, label=i, id=i, id2=0  (recordio.py "IfQQ")
+    std::vector<unsigned char> payload(24 + jpg.size());
+    uint32_t flag = 0;
+    float label = static_cast<float>(i);
+    uint64_t id = i, id2 = 0;
+    std::memcpy(payload.data(), &flag, 4);
+    std::memcpy(payload.data() + 4, &label, 4);
+    std::memcpy(payload.data() + 8, &id, 8);
+    std::memcpy(payload.data() + 16, &id2, 8);
+    std::memcpy(payload.data() + 24, jpg.data(), jpg.size());
+    WriteRec(fp, payload.data(), static_cast<long>(payload.size()));
+  }
+  std::fclose(fp);
+
+  long n = mxio_scan_records(path.c_str(), nullptr, nullptr, 0);
+  CHECK(n == 10);
+  std::vector<long> offs(n), lens(n);
+  mxio_scan_records(path.c_str(), offs.data(), lens.data(), n);
+
+  void* pipe = mxio_pipe_create(path.c_str(), offs.data(), lens.data(), n,
+                                /*batch=*/4, 3, 32, 32, /*resize=*/36,
+                                /*rand_crop=*/0, /*rand_mirror=*/0, nullptr,
+                                nullptr, /*label_width=*/1, /*threads=*/3,
+                                /*depth=*/2, /*seed=*/1);
+  CHECK(pipe != nullptr);
+  std::vector<long> order(n);
+  for (long i = 0; i < n; ++i) order[i] = i;
+  std::vector<float> data(4 * 3 * 32 * 32), label(4);
+
+  for (int epoch = 0; epoch < 2; ++epoch) {
+    CHECK(mxio_pipe_reset(pipe, order.data(), n) == 0);
+    int batches = 0;
+    long pad = 0;
+    float first_label = -1;
+    while (true) {
+      int rc = mxio_pipe_next(pipe, data.data(), label.data(), &pad);
+      if (rc == 1) break;
+      CHECK(rc == 0);
+      if (batches == 0) first_label = label[0];
+      ++batches;
+    }
+    CHECK(batches == 3);       // ceil(10/4)
+    CHECK(pad == 2);           // tail wraps 2 records
+    CHECK(first_label == 0.0f);  // in-order delivery
+  }
+
+  // corrupt record -> error surfaces, not a hang/crash
+  std::vector<long> bad_lens = lens;
+  bad_lens[0] = 10;  // payload shorter than IRHeader
+  void* bad = mxio_pipe_create(path.c_str(), offs.data(), bad_lens.data(),
+                               n, 4, 3, 32, 32, 36, 0, 0, nullptr, nullptr,
+                               1, 2, 2, 1);
+  CHECK(bad != nullptr);
+  CHECK(mxio_pipe_reset(bad, order.data(), n) == 0);
+  int rc = 0;
+  for (int i = 0; i < 3 && rc == 0; ++i)
+    rc = mxio_pipe_next(bad, data.data(), label.data(), nullptr);
+  CHECK(rc == -1);
+  mxio_pipe_destroy(bad);
+  mxio_pipe_destroy(pipe);
+}
+#endif  // MXIO_HAS_JPEG
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const std::string dir = argc > 1 ? argv[1] : ".";
+  TestRecordioRoundTrip(dir);
+  Test2BitNumerics();
+  TestChwConversion();
+#if defined(MXIO_HAS_JPEG)
+  if (mxio_has_jpeg()) TestPipeOrderingAndReset(dir);
+#endif
+  if (g_failures == 0) {
+    std::printf("ALL NATIVE TESTS PASSED\n");
+    return 0;
+  }
+  std::fprintf(stderr, "%d native test failures\n", g_failures);
+  return 1;
+}

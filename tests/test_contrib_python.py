@@ -205,7 +205,7 @@ def test_torch_module_trains_inside_record():
             pred = op(X)
             loss = mx.nd.mean(mx.nd.square(pred - y))
         loss.backward()
-        losses.append(float(loss.asnumpy()))
+        losses.append(loss.asnumpy().item())
         op.step(0.1)                     # mxnet owns the torch weights
     assert losses[-1] < losses[0] * 0.05, losses[::10]
     # trained values round-trip into the torch module
@@ -226,7 +226,7 @@ def test_torch_loss_and_eval_function():
     with mx.autograd.record():
         loss = crit(p, mx.nd.array(tv))
     loss.backward()
-    np.testing.assert_allclose(float(loss.asnumpy()),
+    np.testing.assert_allclose(loss.asnumpy().item(),
                                np.mean((pv - tv) ** 2), rtol=1e-5)
     np.testing.assert_allclose(p.grad.asnumpy(), 2 * (pv - tv) / pv.size,
                                rtol=1e-4)
@@ -269,3 +269,25 @@ def test_torch_embedding_int_inputs():
     loss.backward()
     g = op.params[0].grad.asnumpy()
     assert sorted(np.where(np.abs(g).sum(1) > 0)[0].tolist()) == [1, 3, 5]
+
+
+def test_torch_dropout_mask_consistent_with_grads():
+    # forward runs twice (eager + backward replay); the per-call pinned
+    # torch seed must give both runs the SAME dropout mask, or gradients
+    # decouple from the reported output
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.contrib import torch_bridge
+    net = torch.nn.Sequential(torch.nn.Linear(8, 8), torch.nn.Dropout(0.5))
+    net.train()
+    op = torch_bridge.TorchModule(net)
+    x = mx.nd.array(np.ones((4, 8), np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = op(x)
+        loss = mx.nd.sum(y)
+    loss.backward()
+    yv = y.asnumpy()
+    gw = op.params[0].grad.asnumpy()
+    zero_units = np.where(np.abs(yv).sum(0) == 0)[0]
+    assert len(zero_units) > 0
+    assert np.abs(gw[zero_units]).max() == 0.0

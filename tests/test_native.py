@@ -281,3 +281,26 @@ def test_native_pipe_more_workers_than_buffers(tmp_path):
             time.sleep(0.005)
         assert seen == 20
     pipe.close()
+
+
+def test_cpp_unit_harness(tmp_path):
+    """Build and run the native-side unit tests (tests/cpp tier of the
+    reference, SURVEY.md §4) — exercises the C ABI from C++ with no
+    python in the loop."""
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+    exe = tmp_path / "native_test"
+    cmd = ["g++", "-O2", "-std=c++17", "-DMXIO_HAS_JPEG",
+           os.path.join(src_dir, "runtime_native_test.cc"),
+           os.path.join(src_dir, "runtime_native.cc"),
+           "-ljpeg", "-lpthread", "-o", str(exe)]
+    build = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    if build.returncode != 0:
+        cmd = [c for c in cmd if c not in ("-DMXIO_HAS_JPEG", "-ljpeg")]
+        build = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([str(exe), str(tmp_path)], capture_output=True,
+                         text=True, timeout=120)
+    assert run.returncode == 0, (run.stdout + run.stderr)[-2000:]
+    assert "ALL NATIVE TESTS PASSED" in run.stdout
