@@ -107,7 +107,10 @@ class TorchModule:
                         t.requires_grad_(True)
                     tall.append(t)
                 with torch.random.fork_rng(devices=[]):
-                    torch.manual_seed(call_seed)
+                    # CPU generator only: torch.manual_seed would clobber
+                    # the user's CUDA/MPS generators, which fork_rng
+                    # (devices=[]) does not restore
+                    torch.default_generator.manual_seed(call_seed)
                     out = bridge._functional(torch, tall[:n_in],
                                              tall[n_in:])
                 self._tall = tall

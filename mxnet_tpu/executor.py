@@ -49,6 +49,13 @@ def _build_runner(symbol, is_train, group2dev=None, platform=None):
     node_pos = {id(n): i for i, n in enumerate(topo)}
     out_entries = [(node_pos[id(n)], i) for (n, i) in symbol._outputs]
 
+    # MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md; graph_executor mirror
+    # pass): trade FLOPs for HBM by rematerializing each op's internals in
+    # the backward — jax.checkpoint per node keeps only op-boundary
+    # activations live, the TPU-native realization of activation mirroring
+    from . import config as _config
+    do_mirror = is_train and bool(_config.get("MXNET_BACKWARD_DO_MIRROR"))
+
     # count rng consumers for key splitting
     rng_nodes = [id(n) for n in topo
                  if n.op is not None and n.op.needs_rng]
@@ -75,7 +82,13 @@ def _build_runner(symbol, is_train, group2dev=None, platform=None):
             node_platform = grp_dev.platform if grp_dev is not None \
                 else platform
             octx = OpCtx(is_train=is_train, rng=key, platform=node_platform)
-            res = node.op.fcompute(parsed, octx, *ins)
+            if do_mirror:
+                def _call(k, *a, _op=node.op, _p=parsed, _pf=node_platform):
+                    return _op.fcompute(
+                        _p, OpCtx(is_train=True, rng=k, platform=_pf), *a)
+                res = jax.checkpoint(_call)(key, *ins)
+            else:
+                res = node.op.fcompute(parsed, octx, *ins)
             if not isinstance(res, tuple):
                 res = (res,)
             if grp_dev is not None:

@@ -199,3 +199,36 @@ def test_variable_compose():
     composed = net(data=other)
     assert "other" in composed.list_arguments()
     assert "data" not in composed.list_arguments()
+
+
+def test_backward_do_mirror_grad_parity():
+    """MXNET_BACKWARD_DO_MIRROR=1 rematerializes per-op internals
+    (jax.checkpoint) — gradients must be identical to the unmirrored
+    path (reference mirror pass is numerics-preserving)."""
+    import os
+
+    def grads(mirror):
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+        try:
+            data = mx.sym.Variable("data")
+            net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+            net = mx.sym.Activation(net, act_type="tanh")
+            net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+            net = mx.sym.SoftmaxOutput(net, name="softmax")
+            ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6),
+                                 softmax_label=(4,))
+            rng = np.random.RandomState(0)
+            for n, arr in ex.arg_dict.items():
+                if n not in ("data", "softmax_label"):
+                    arr[:] = rng.normal(0, 0.1, arr.shape)
+            ex.forward(is_train=True,
+                       data=rng.normal(size=(4, 6)).astype(np.float32),
+                       softmax_label=np.array([0, 1, 2, 0], np.float32))
+            ex.backward()
+            return {n: g.asnumpy() for n, g in ex.grad_dict.items()}
+        finally:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+
+    g0, g1 = grads(False), grads(True)
+    for n in g0:
+        np.testing.assert_allclose(g0[n], g1[n], rtol=1e-5, atol=1e-6)

@@ -334,3 +334,18 @@ def test_native_iter_feeds_module_on_chip(tmp_path):
     acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
     assert acc > 0.9
     it.close()
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_dtype_variant_consistency(dtype):
+    """Reference check_consistency sweeps dtypes (fp16/32/64 ctx configs,
+    test_utils.py:1207); here the TPU-relevant reduced precisions."""
+    d = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(mx.sym.Activation(d, act_type="tanh"),
+                                num_hidden=8, name="fc")
+    shapes = {"data": (4, 6)}
+    ctx_list = [dict(ctx=mx.cpu(0), type_dict={"data": dtype}, **shapes),
+                dict(ctx=mx.tpu(0), type_dict={"data": dtype}, **shapes)]
+    # reduced-precision storage: wide tolerances, but both backends must
+    # agree to within a few representable steps
+    check_consistency(sym, ctx_list, rtol=5e-2, atol=5e-2)
