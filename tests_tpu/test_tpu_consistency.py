@@ -349,3 +349,37 @@ def test_dtype_variant_consistency(dtype):
     # reduced-precision storage: wide tolerances, but both backends must
     # agree to within a few representable steps
     check_consistency(sym, ctx_list, rtol=5e-2, atol=5e-2)
+
+
+def test_profiler_chrome_trace_on_chip(tmp_path):
+    """mx.profiler captures per-op events from a real-chip Module.fit and
+    dumps a chrome://tracing-loadable JSON (profiler.h:87 role)."""
+    import json
+    out = str(tmp_path / "trace.json")
+    mx.profiler.set_config(profile_all=True, filename=out)
+    try:
+        mx.profiler.set_state("run")
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(64, 16)).astype(np.float32)
+        y = (X.sum(1) > 0).astype(np.float32)
+        it = mx.io.NDArrayIter({"data": X}, {"softmax_label": y},
+                               batch_size=32)
+        net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.tpu(0))
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.init.Xavier())
+        mx.profiler.set_state("stop")
+        mx.profiler.dump()
+    finally:
+        # never leak run-state/profile_all into the rest of the lane
+        mx.profiler.set_state("stop")
+        mx.profiler.set_config(profile_all=False, filename=None)
+    tr = json.load(open(out))
+    events = tr["traceEvents"] if isinstance(tr, dict) else tr
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert len(events) > 5
+    assert any("Forward" in (n or "") for n in names)
+    assert "sgd_update" in names
