@@ -16,11 +16,15 @@ reference chip's INFERENCE img/s on the same model, i.e. a conservative
 lower bound (training is ~3x the FLOPs of inference). The exact
 inference-vs-inference ratio is reported as `inference_vs_baseline`.
 
-MFU = achieved_flops / peak: ResNet-50 fwd ~= 4.09 GFLOP/img at 224^2
-(2*MACs), train ~= 3x fwd. Peak denominator is the v5e bf16 MXU peak
-(197 TFLOP/s): params are fp32, but XLA's DEFAULT conv/matmul precision
-on TPU executes them as single-pass bf16 on the MXU, so bf16 peak is the
-comparable ceiling.
+MFU accounting: model FLOPs are read from XLA's own cost analysis of the
+compiled step executable (compile().cost_analysis()['flops']) — NOT a
+hand-maintained constant. ResNet-50 fwd is 4.09 GMACs = 8.18 GFLOPs/img
+(2 FLOPs per MAC); a full training step measures ~23.8 GFLOP/img (fwd +
+grad-weights + grad-activations; the data tensor gets no gradient). Round-2
+reported half the true MFU by using the GMAC figure as if it were FLOPs —
+see docs/perf_analysis_r03.md for the trace-backed derivation and the
+HBM-roofline analysis of where the remaining time goes. Peak denominator is
+the v5e bf16 MXU peak (197 TFLOP/s).
 """
 from __future__ import annotations
 
@@ -31,8 +35,8 @@ import numpy as np
 
 TRAIN_BATCH = 128
 INFER_BATCH = 32
-RN50_FWD_FLOPS_PER_IMG = 4.09e9   # 2*MACs, 224x224
-TRAIN_FLOPS_PER_IMG = 3.0 * RN50_FWD_FLOPS_PER_IMG
+RN50_FWD_FLOPS_PER_IMG = 8.18e9   # fallback only: 2 FLOPs x 4.09 GMACs
+TRAIN_FLOPS_PER_IMG = 2.9 * RN50_FWD_FLOPS_PER_IMG  # fallback only
 V5E_PEAK_FLOPS = 197e12           # bf16
 
 K80_RN50_INFER_B32 = 109.0        # README.md:154
@@ -47,7 +51,19 @@ def _resnet50_symbol():
     return mx.sym.SoftmaxOutput(net(data), name="softmax")
 
 
-def _train_ips(sym, mesh, dtype):
+def _cost_flops(jitted, *args):
+    """Model FLOPs of a compiled executable, from XLA's cost analysis.
+    Returns None if the backend doesn't support it."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+    except Exception:
+        return None
+
+
+def _train_ips(sym, mesh, dtype, want_flops=False):
     from mxnet_tpu.parallel import DataParallelTrainer
     trainer = DataParallelTrainer(sym, mesh, optimizer="sgd",
                                   learning_rate=0.05, momentum=0.9,
@@ -63,6 +79,11 @@ def _train_ips(sym, mesh, dtype):
         params, states, aux, loss, _ = trainer.step(params, states, aux,
                                                     inputs)
     float(loss)
+    step_flops = None
+    if want_flops:
+        step_flops = _cost_flops(trainer._step, params, states, aux, inputs,
+                                 trainer._rng_dev, trainer._lr_dev,
+                                 trainer._t_dev)
     # median of 3 trials: the shared chip/tunnel shows transient
     # contention windows (3-4x inflation observed); the median resists a
     # single bad window without the upward bias of best-of
@@ -74,11 +95,34 @@ def _train_ips(sym, mesh, dtype):
                                                         inputs)
         float(loss)  # block on the chain
         rates.append(n_steps * TRAIN_BATCH / (time.perf_counter() - t0))
-    return sorted(rates)[1], trainer, params, aux, x, y
+    return (sorted(rates)[1], step_flops, trainer, params, aux, x, y)
+
+
+def _infer_ips(run, argv, aux, key, want_flops=False):
+    """Median-of-3 timed inference loops over a prebuilt jitted runner."""
+    import jax
+    infer = jax.jit(lambda a, s, r: run(a, s, r)[0][0])
+    # sync via host fetch: through the axon tunnel, block_until_ready was
+    # MEASURED to return before remote execution completes (0.9ms/step
+    # "rates" vs 200ms/step real), so a small device->host fetch is the
+    # reliable completion barrier here
+    np.asarray(infer(argv, aux, key))
+    # cost_analysis pays a second AOT compile — only when asked for
+    flops = _cost_flops(infer, argv, aux, key) if want_flops else None
+    n_inf, inf_rates = 50, []
+    for _ in range(3):  # median-of-3 against transient tunnel contention
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_inf):
+            out = infer(argv, aux, key)
+        np.asarray(out)
+        inf_rates.append(n_inf * INFER_BATCH / (time.perf_counter() - t0))
+    return sorted(inf_rates)[1], flops
 
 
 def main():
     import jax
+    import jax.numpy as jnp
     from mxnet_tpu.parallel import data_parallel_mesh
 
     sym = _resnet50_symbol()
@@ -88,11 +132,14 @@ def main():
     # params, bf16 compute — the reference trains its fp16 configs the same
     # way, SURVEY §7); fp32 reported alongside ---------------------------------
     fp32_ips = _train_ips(sym, mesh, "float32")[0]   # drop fp32 buffers
-    bf16_ips, trainer, params, aux, x, y = _train_ips(sym, mesh, "bfloat16")
+    bf16_ips, step_flops, trainer, params, aux, x, y = _train_ips(
+        sym, mesh, "bfloat16", want_flops=True)
     train_ips = bf16_ips
-    mfu = train_ips * TRAIN_FLOPS_PER_IMG / V5E_PEAK_FLOPS
+    train_flops_img = (step_flops / TRAIN_BATCH if step_flops
+                       else TRAIN_FLOPS_PER_IMG)
+    mfu = train_ips * train_flops_img / V5E_PEAK_FLOPS
 
-    # -- inference (exact baseline config: batch 32) -------------------------
+    # -- inference (exact baseline config: batch 32), fp32 and bf16 ----------
     from mxnet_tpu.executor import _build_runner
     run = _build_runner(sym, is_train=False)
     arg_names = sym.list_arguments()
@@ -101,21 +148,17 @@ def main():
         [x[:INFER_BATCH], y[:INFER_BATCH], jax.random.PRNGKey(0)])
     argv = tuple(pmap[n] if n in pmap else (xi if n == "data" else yi)
                  for n in arg_names)
-    infer = jax.jit(lambda a, s, r: run(a, s, r)[0][0])
-    # sync via host fetch: through the axon tunnel, block_until_ready was
-    # MEASURED to return before remote execution completes (0.9ms/step
-    # "rates" vs 200ms/step real), so a small device->host fetch is the
-    # reliable completion barrier here
-    np.asarray(infer(argv, aux, key))
-    n_inf, inf_rates = 50, []
-    for _ in range(3):  # median-of-3 against transient tunnel contention
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(n_inf):
-            out = infer(argv, aux, key)
-        np.asarray(out)
-        inf_rates.append(n_inf * INFER_BATCH / (time.perf_counter() - t0))
-    infer_ips = sorted(inf_rates)[1]
+    infer_ips, _ = _infer_ips(run, argv, aux, key)
+    # bf16 inference: weights + data in bf16, vector params (gamma/beta/
+    # bias) and BN running stats stay fp32 — ops cast at use sites
+    argv16 = tuple(v.astype(jnp.bfloat16) if v.ndim > 1 and
+                   jnp.issubdtype(v.dtype, jnp.floating) else v
+                   for v in argv)
+    infer16_ips, infer16_flops = _infer_ips(run, argv16, aux, key,
+                                            want_flops=True)
+    infer_flops_img = (infer16_flops / INFER_BATCH if infer16_flops
+                       else RN50_FWD_FLOPS_PER_IMG)
+    infer_mfu = infer16_ips * infer_flops_img / V5E_PEAK_FLOPS
 
     print(json.dumps({
         "metric": "resnet50_train_throughput",
@@ -123,11 +166,19 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(train_ips / K80_RN50_INFER_B32, 2),
         "mfu": round(mfu, 4),
+        "train_flops_per_img": round(train_flops_img / 1e9, 2),
+        "flops_source": "xla_cost_analysis" if step_flops else "fallback",
         "train_batch": TRAIN_BATCH,
         "train_dtype": "bfloat16(mp)",
         "fp32_train_ips": round(fp32_ips, 2),
         "inference_b32_ips": round(infer_ips, 2),
+        "inference_bf16_b32_ips": round(infer16_ips, 2),
+        "inference_bf16_mfu": round(infer_mfu, 4),
+        # fp32-vs-fp32 like round 2 (the K80 baseline is fp32); the bf16
+        # ratio is reported separately so cross-round series stay honest
         "inference_vs_baseline": round(infer_ips / K80_RN50_INFER_B32, 2),
+        "inference_bf16_vs_baseline": round(
+            infer16_ips / K80_RN50_INFER_B32, 2),
         "vs_k80_resnet152_train": round(train_ips / K80_RN152_TRAIN, 2),
         "timing": "median-of-3x20-steps",
     }))

@@ -40,11 +40,41 @@ def _prod(xs):
 # FullyConnected (src/operator/nn/fully_connected.cc)
 # ---------------------------------------------------------------------------
 
+@jax.custom_vjp
+def _bias_add_dead_grad(y, b):
+    """y + b where d(b) is a structural zero.
+
+    Applied by the executor's dead-bias pass (executor.py:_dead_bias_convs)
+    when the op's only consumer is a batch-stats BatchNorm: the BN output is
+    invariant to a per-channel shift, so the true bias gradient is exactly
+    zero — this just stops XLA from spending a full pass over dy to compute
+    that zero. Forward is bit-identical to a plain add.
+    """
+    return y + b
+
+
+def _bias_add_dead_fwd(y, b):
+    return y + b, b  # b is a (C,)-sized vector; kept only for zeros_like
+
+
+def _bias_add_dead_bwd(b, dy):
+    return dy, jnp.zeros_like(b)
+
+
+_bias_add_dead_grad.defvjp(_bias_add_dead_fwd, _bias_add_dead_bwd)
+
+
+def _add_bias(attrs, y, bias):
+    if attrs.get("__bias_grad_dead__"):
+        return _bias_add_dead_grad(y, bias.astype(y.dtype))
+    return y + bias.astype(y.dtype)
+
+
 def _fc(attrs, octx, data, weight, bias=None):
     x = data.reshape(data.shape[0], -1) if attrs["flatten"] else data
     y = jnp.matmul(x, weight.T)  # weight: (num_hidden, in_dim) — MXNet layout
     if not attrs["no_bias"]:
-        y = y + bias
+        y = _add_bias(attrs, y, bias)
     return _t(y)
 
 
@@ -109,7 +139,9 @@ def _conv(attrs, octx, data, weight, bias=None):
     if y.dtype != data.dtype:
         y = y.astype(data.dtype)
     if not attrs["no_bias"]:
-        y = y + bias.reshape((1, -1) + (1,) * ns)
+        # bias cast at the use site: a fp32 bias must not promote bf16
+        # activations (mixed-precision discipline, same as _batch_norm)
+        y = _add_bias(attrs, y, bias.reshape((1, -1) + (1,) * ns))
     return _t(y)
 
 
@@ -181,7 +213,7 @@ def _deconv(attrs, octx, data, weight, bias=None):
         lhs_dilation=stride, rhs_dilation=dilate,
         dimension_numbers=_CONV_SPECS[ns], feature_group_count=g)
     if not attrs["no_bias"]:
-        y = y + bias.reshape((1, -1) + (1,) * ns)
+        y = y + bias.reshape((1, -1) + (1,) * ns).astype(y.dtype)
     return _t(y)
 
 
@@ -486,29 +518,106 @@ register("SoftmaxOutput", _softmax_output,
 # Normalization layers
 # ---------------------------------------------------------------------------
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _bn_train(data, gamma, beta, axis, eps, fix_gamma, relu):
+    """Training-mode BN core: returns (out, batch_mean, batch_var).
+
+    Hand-written vjp for HBM-roofline reasons (docs/perf_analysis_r03.md):
+    the forward computes mean and E[x^2] in ONE pass so XLA fuses both
+    reductions into the producing conv's epilogue, and the backward does the
+    minimal two passes (one for the dgamma/dbeta sums, one for dx) instead
+    of autodiff's mean->var dependency chain. Stats accumulate in fp32
+    regardless of the activation dtype. `relu` folds a following
+    Activation('relu') node into the kernel (executor BN+ReLU fusion pass):
+    the backward masks dy inline instead of paying a separate full
+    read+write pass over the activation tensor.
+    """
+    return _bn_train_fwd(data, gamma, beta, axis, eps, fix_gamma, relu)[0]
+
+
+def _bn_stats(data, red_axes):
+    m = jnp.mean(data, axis=red_axes, dtype=jnp.float32)
+    m2 = jnp.mean(jax.lax.square(data), axis=red_axes, dtype=jnp.float32)
+    return m, m2 - jax.lax.square(m)
+
+
+def _bn_train_fwd(data, gamma, beta, axis, eps, fix_gamma, relu):
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    mean, var = _bn_stats(data, red_axes)
+    rstd = jax.lax.rsqrt(var + eps)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = (g.astype(jnp.float32) * rstd).astype(data.dtype)
+    shift = (beta.astype(jnp.float32)
+             - mean * g.astype(jnp.float32) * rstd).astype(data.dtype)
+    out = data * scale.reshape(bshape) + shift.reshape(bshape)
+    if relu:
+        out = jnp.maximum(out, 0)
+    return (out, mean, var), (data, gamma, beta, mean, rstd)
+
+
+def _bn_train_bwd(axis, eps, fix_gamma, relu, res, cts):
+    # cotangents for the mean/var outputs are ignored: callers feed them
+    # only into the stop-gradient EMA update, so they are exact zeros
+    data, gamma, beta, mean, rstd = res
+    dy = cts[0]
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    n = _prod(data.shape[i] for i in red_axes)
+    xhat = (data - mean.reshape(bshape).astype(data.dtype)) \
+        * rstd.reshape(bshape).astype(data.dtype)
+    if relu:
+        # recompute the relu mask from xhat (cheaper than saving `out`:
+        # out > 0 <=> g*xhat + beta > 0, all in-registers here)
+        g_b = (jnp.ones_like(gamma) if fix_gamma else gamma) \
+            .reshape(bshape).astype(data.dtype)
+        pre = xhat * g_b + beta.reshape(bshape).astype(data.dtype)
+        dy = jnp.where(pre > 0, dy, jnp.zeros((), dy.dtype))
+    # pass 1: both channel reductions stream (dy, data) once
+    dbeta = jnp.sum(dy, axis=red_axes, dtype=jnp.float32)
+    dgamma = jnp.sum(dy * xhat, axis=red_axes, dtype=jnp.float32)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    coef = (g.astype(jnp.float32) * rstd).reshape(bshape).astype(data.dtype)
+    # pass 2: dx from dy, data and the reduced sums
+    dx = coef * (dy
+                 - (dbeta / n).reshape(bshape).astype(data.dtype)
+                 - xhat * (dgamma / n).reshape(bshape).astype(data.dtype))
+    dgamma_out = jnp.zeros_like(gamma) if fix_gamma \
+        else dgamma.astype(gamma.dtype)
+    return dx, dgamma_out, dbeta.astype(gamma.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 def _batch_norm(attrs, octx, data, gamma, beta, moving_mean, moving_var):
     eps = attrs["eps"]
     momentum = attrs["momentum"]
     axis = attrs["axis"] % data.ndim
-    red_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
 
-    g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma
+    fuse_relu = bool(attrs.get("__fuse_relu__", False))
     use_batch = octx.is_train and not attrs["use_global_stats"]
     if use_batch:
-        mean = jnp.mean(data, axis=red_axes)
-        var = jnp.var(data, axis=red_axes)
-        new_mean = momentum * moving_mean + (1 - momentum) * jax.lax.stop_gradient(mean)
-        new_var = momentum * moving_var + (1 - momentum) * jax.lax.stop_gradient(var)
-    else:
-        mean, var = moving_mean, moving_var
-        new_mean, new_var = moving_mean, moving_var
+        out, mean, var = _bn_train(data, gamma, beta, axis, eps,
+                                   bool(attrs["fix_gamma"]), fuse_relu)
+        mean = jax.lax.stop_gradient(mean).astype(moving_mean.dtype)
+        var = jax.lax.stop_gradient(var).astype(moving_var.dtype)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+        return (out, new_mean, new_var)
+    g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma
+    mean, var = moving_mean, moving_var
     inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
     out = (data - mean.reshape(bshape).astype(data.dtype)) * \
         inv.reshape(bshape) * g.reshape(bshape).astype(data.dtype) + \
         beta.reshape(bshape).astype(data.dtype)
-    return (out, new_mean, new_var)
+    if fuse_relu:
+        out = jnp.maximum(out, 0)
+    return (out, mean, var)
 
 
 def _bn_infer(attrs, in_shapes):

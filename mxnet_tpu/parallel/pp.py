@@ -40,11 +40,12 @@ def _pipe_shard(x_micro, w, b, axis_name, n_micro):
     ticks = n_micro + n - 1
     fwd_perm = [(i, i + 1) for i in range(n - 1)]     # stage s -> s+1
 
-    # pvary marks the carries as device-varying so the fori_loop carry
-    # typecheck accepts the (rank-dependent) tick outputs
-    y0 = jax.lax.pvary(jnp.zeros((bsz, d), x_micro.dtype), (axis_name,))
-    outs0 = jax.lax.pvary(jnp.zeros((n_micro, bsz, d), x_micro.dtype),
-                          (axis_name,))
+    # pcast-to-varying marks the carries as device-varying so the fori_loop
+    # carry typecheck accepts the (rank-dependent) tick outputs
+    y0 = jax.lax.pcast(jnp.zeros((bsz, d), x_micro.dtype), (axis_name,),
+                       to="varying")
+    outs0 = jax.lax.pcast(jnp.zeros((n_micro, bsz, d), x_micro.dtype),
+                          (axis_name,), to="varying")
 
     def tick(t, carry):
         y_prev, outs = carry

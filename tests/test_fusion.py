@@ -1,0 +1,143 @@
+"""Executor graph-fusion passes: BN+ReLU fusion and the dead-bias pass.
+
+The fused executor (executor.py:_fuse_bn_relu, _dead_bias_convs) must be
+semantically invisible: outputs and gradients match the unfused imperative
+path (which applies no passes). Reference analog: cuDNN fused
+BN+Activation must match the unfused graph (tests/python/gpu
+check_consistency discipline).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _bn_relu_sym(with_bias, fix_gamma=False):
+    x = mx.sym.Variable("x")
+    conv = mx.sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              no_bias=not with_bias, name="conv")
+    bn = mx.sym.BatchNorm(conv, fix_gamma=fix_gamma, name="bn")
+    act = mx.sym.Activation(bn, act_type="relu", name="relu")
+    # sum head so backward() has a scalar-equivalent cotangent
+    return mx.sym.sum(act)
+
+
+def _imperative_ref(args, with_bias, fix_gamma):
+    """Unfused reference: same graph through imperative ops + autograd."""
+    nds = {k: mx.nd.array(v) for k, v in args.items()}
+    for v in nds.values():
+        v.attach_grad()
+    with autograd.record():
+        kw = {} if with_bias else {}
+        if with_bias:
+            y = mx.nd.Convolution(nds["x"], nds["conv_weight"],
+                                  nds["conv_bias"], kernel=(3, 3),
+                                  num_filter=8, pad=(1, 1), no_bias=False)
+        else:
+            y = mx.nd.Convolution(nds["x"], nds["conv_weight"],
+                                  kernel=(3, 3), num_filter=8, pad=(1, 1),
+                                  no_bias=True)
+        y = mx.nd.BatchNorm(y, nds["bn_gamma"], nds["bn_beta"],
+                            mx.nd.zeros((8,)), mx.nd.ones((8,)),
+                            fix_gamma=fix_gamma)
+        y = mx.nd.relu(y)
+        out = mx.nd.sum(y)
+    out.backward(train_mode=True)
+    return out, {k: v.grad for k, v in nds.items()}
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+@pytest.mark.parametrize("fix_gamma", [False, True])
+def test_fused_executor_matches_imperative(with_bias, fix_gamma):
+    rng = np.random.RandomState(7)
+    args = {
+        "x": rng.normal(0, 1, (4, 3, 8, 8)).astype(np.float32),
+        "conv_weight": rng.normal(0, 0.2, (8, 3, 3, 3)).astype(np.float32),
+        "bn_gamma": rng.uniform(0.5, 1.5, (8,)).astype(np.float32),
+        "bn_beta": rng.normal(0, 0.2, (8,)).astype(np.float32),
+    }
+    if with_bias:
+        args["conv_bias"] = rng.normal(0, 0.5, (8,)).astype(np.float32)
+
+    sym = _bn_relu_sym(with_bias, fix_gamma)
+    exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                          **{k: v.shape for k, v in args.items()})
+    for k, v in args.items():
+        exe.arg_dict[k][:] = v
+    out = exe.forward(is_train=True)[0]
+    exe.backward()
+
+    ref_out, ref_grads = _imperative_ref(args, with_bias, fix_gamma)
+    np.testing.assert_allclose(out.asnumpy(), ref_out.asnumpy(),
+                               rtol=2e-4, atol=2e-4)
+    for k in args:
+        np.testing.assert_allclose(
+            exe.grad_dict[k].asnumpy(), ref_grads[k].asnumpy(),
+            rtol=2e-3, atol=2e-3, err_msg=f"grad mismatch for {k}")
+
+
+def test_dead_bias_grad_is_zero():
+    """Bias grad through a batch-stats BN is mathematically zero; the
+    executor pass returns a structural zero (executor.py:_dead_bias_convs)."""
+    rng = np.random.RandomState(3)
+    args = {
+        "x": rng.normal(0, 1, (4, 3, 8, 8)).astype(np.float32),
+        "conv_weight": rng.normal(0, 0.2, (8, 3, 3, 3)).astype(np.float32),
+        "conv_bias": rng.normal(0, 0.5, (8,)).astype(np.float32),
+        "bn_gamma": rng.uniform(0.5, 1.5, (8,)).astype(np.float32),
+        "bn_beta": rng.normal(0, 0.2, (8,)).astype(np.float32),
+    }
+    sym = _bn_relu_sym(with_bias=True)
+    exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                          **{k: v.shape for k, v in args.items()})
+    for k, v in args.items():
+        exe.arg_dict[k][:] = v
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.all(exe.grad_dict["conv_bias"].asnumpy() == 0.0)
+
+
+def test_fc_noflatten_bias_grad_not_dead():
+    """FC(flatten=False) with rank-3 output + BatchNorm(axis=1): the bias
+    broadcasts on the LAST axis, which axis-1 BN reduces over — the shift
+    is NOT per-channel constant, so the bias gradient is real and the
+    dead-bias pass must leave it alone (code-review regression)."""
+    rng = np.random.RandomState(11)
+    x = mx.sym.Variable("x")
+    fc = mx.sym.FullyConnected(x, num_hidden=5, flatten=False, name="fc")
+    bn = mx.sym.BatchNorm(fc, fix_gamma=False, axis=1, name="bn")
+    sym = mx.sym.sum(bn * bn)   # nonlinear head so grads are nontrivial
+    shapes = {"x": (4, 3, 6), "fc_weight": (5, 6), "fc_bias": (5,),
+              "bn_gamma": (3,), "bn_beta": (3,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    for k, s in shapes.items():
+        exe.arg_dict[k][:] = rng.normal(0.5, 0.3, s).astype(np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.abs(exe.grad_dict["fc_bias"].asnumpy()).max() > 1e-4, \
+        "real bias gradient was zeroed by the dead-bias pass"
+
+
+def test_bn_relu_not_fused_when_bn_multiply_consumed():
+    """BN output consumed by relu AND another op must not be fused —
+    the second consumer needs the pre-relu value."""
+    x = mx.sym.Variable("x")
+    bn = mx.sym.BatchNorm(x, fix_gamma=False, name="bn")
+    act = mx.sym.Activation(bn, act_type="relu", name="relu")
+    both = act + bn     # second consumer sees pre-relu values
+    sym = mx.sym.sum(both)
+    shape = (2, 3, 4, 4)
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", x=shape,
+                          bn_gamma=(3,), bn_beta=(3,))
+    rng = np.random.RandomState(0)
+    xv = rng.normal(0, 1, shape).astype(np.float32)
+    exe.arg_dict["x"][:] = xv
+    exe.arg_dict["bn_gamma"][:] = np.ones(3, np.float32)
+    exe.arg_dict["bn_beta"][:] = np.zeros(3, np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    # reference: normalize per channel over batch stats, relu + identity
+    xn = (xv - xv.mean(axis=(0, 2, 3), keepdims=True)) / np.sqrt(
+        xv.var(axis=(0, 2, 3), keepdims=True) + 1e-3)
+    expect = (np.maximum(xn, 0) + xn).sum()
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-3)

@@ -1,0 +1,157 @@
+"""MFU experiment: NCHW vs NHWC ResNet-50 train step, pure jax.
+
+Isolates the conv-layout question from the framework: same model, same
+fusion structure as DataParallelTrainer (fwd+bwd+sgd-mom in one jit),
+bf16 compute / fp32 master params.
+"""
+import functools
+import time
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FWD_FLOPS = 4.09e9
+PEAK = 197e12
+
+
+def conv(x, w, stride, layout):
+    dn = ("NCHW", "OIHW", "NCHW") if layout == "NCHW" else \
+         ("NHWC", "HWIO", "NHWC")
+    pad = [(w.shape[2] // 2, w.shape[2] // 2)] * 2 if layout == "NCHW" else \
+          [(w.shape[0] // 2, w.shape[0] // 2)] * 2
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=pad,
+        dimension_numbers=dn)
+
+
+def bn_relu(x, scale, bias, layout, relu=True):
+    ax = (0, 2, 3) if layout == "NCHW" else (0, 1, 2)
+    shape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    m = jnp.mean(x, axis=ax, keepdims=True)
+    v = jnp.var(x, axis=ax, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + 1e-5)
+    y = y * scale.reshape(shape).astype(x.dtype) \
+        + bias.reshape(shape).astype(x.dtype)
+    return jax.nn.relu(y) if relu else y
+
+
+def make_params(layout, rng):
+    """ResNet-50 v1 params as a flat list of (kind, shape)."""
+    params = []
+
+    def cw(cin, cout, k):
+        s = (cout, cin, k, k) if layout == "NCHW" else (k, k, cin, cout)
+        params.append(rng.normal(0, 0.05, s).astype(np.float32))
+        return len(params) - 1
+
+    def bnp(c):
+        params.append(np.ones((c,), np.float32))
+        params.append(np.zeros((c,), np.float32))
+        return len(params) - 2
+
+    spec = []  # list of ops
+    spec.append(("conv", cw(3, 64, 7), 2))
+    spec.append(("bn", bnp(64)))
+    spec.append(("maxpool",))
+    cfg = [(64, 256, 3, 1), (128, 512, 4, 2), (256, 1024, 6, 2),
+           (512, 2048, 3, 2)]
+    cin = 64
+    for mid, cout, blocks, stride in cfg:
+        for b in range(blocks):
+            st = stride if b == 0 else 1
+            proj = cw(cin, cout, 1) if b == 0 else None
+            projbn = bnp(cout) if b == 0 else None
+            spec.append(("block", cw(cin, mid, 1), bnp(mid),
+                         cw(mid, mid, 3), bnp(mid),
+                         cw(mid, cout, 1), bnp(cout), proj, projbn, st))
+            cin = cout
+    params.append(rng.normal(0, 0.01, (2048, 1000)).astype(np.float32))
+    fc_w = len(params) - 1
+    params.append(np.zeros((1000,), np.float32))
+    spec.append(("fc", fc_w, len(params) - 1))
+    return params, spec
+
+
+def forward(params, spec, x, layout):
+    p = params
+    for op in spec:
+        if op[0] == "conv":
+            x = conv(x, p[op[1]], op[2], layout)
+        elif op[0] == "bn":
+            x = bn_relu(x, p[op[1]], p[op[1] + 1], layout)
+        elif op[0] == "maxpool":
+            if layout == "NCHW":
+                x = jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
+                    [(0, 0), (0, 0), (1, 1), (1, 1)])
+            else:
+                x = jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                    [(0, 0), (1, 1), (1, 1), (0, 0)])
+        elif op[0] == "block":
+            _, c1, b1, c2, b2, c3, b3, pr, prb, st = op
+            sc = x
+            y = bn_relu(conv(x, p[c1], 1, layout), p[b1], p[b1 + 1], layout)
+            y = bn_relu(conv(y, p[c2], st, layout), p[b2], p[b2 + 1], layout)
+            y = bn_relu(conv(y, p[c3], 1, layout), p[b3], p[b3 + 1], layout,
+                        relu=False)
+            if pr is not None:
+                sc = bn_relu(conv(x, p[pr], st, layout), p[prb], p[prb + 1],
+                             layout, relu=False)
+            x = jax.nn.relu(y + sc)
+        elif op[0] == "fc":
+            ax = (2, 3) if layout == "NCHW" else (1, 2)
+            x = jnp.mean(x, axis=ax)
+            x = x @ p[op[1]] + p[op[2]]
+    return x
+
+
+def bench(layout, batch, bf16=True):
+    rng = np.random.RandomState(0)
+    params, spec = make_params(layout, rng)
+    params = [jnp.asarray(v) for v in params]
+    moms = [jnp.zeros_like(v) for v in params]
+    shape = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    x = jnp.asarray(rng.uniform(0, 1, shape).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)))
+
+    def loss_fn(params, x, y):
+        if bf16:
+            params_c = [v.astype(jnp.bfloat16) if v.ndim > 1 else v
+                        for v in params]
+            x = x.astype(jnp.bfloat16)
+        else:
+            params_c = params
+        logits = forward(params_c, spec, x, layout).astype(jnp.float32)
+        return -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(batch), y])
+
+    @jax.jit
+    def step(params, moms, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        new_m = [0.9 * m + gi for m, gi in zip(moms, g)]
+        new_p = [p - 0.05 * m for p, m in zip(params, new_m)]
+        return new_p, new_m, loss
+
+    for _ in range(3):
+        params, moms, loss = step(params, moms, x, y)
+    float(loss)
+    n, rates = 20, []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, moms, loss = step(params, moms, x, y)
+        float(loss)
+        rates.append(n * batch / (time.perf_counter() - t0))
+    ips = sorted(rates)[1]
+    mfu = ips * 3 * FWD_FLOPS / PEAK
+    print(f"{layout} b{batch} bf16={bf16}: {ips:.1f} img/s  mfu={mfu:.3f}",
+          flush=True)
+    return ips
+
+
+if __name__ == "__main__":
+    for arg in sys.argv[1:]:
+        layout, b = arg.split(":")
+        bench(layout, int(b))
