@@ -120,6 +120,49 @@ def _infer_ips(run, argv, aux, key, want_flops=False):
     return sorted(inf_rates)[1], flops
 
 
+def _accuracy_lane():
+    """End-to-end convergence on the chip: LeNet on sklearn's bundled
+    handwritten digits (the zero-egress stand-in for the reference's MNIST
+    trainer-integration tier, tests/python/train/test_conv.py; same models
+    asserted >0.97 in tests/test_train_accuracy.py on CPU). Returns the
+    held-out accuracy actually reached on the TPU."""
+    import mxnet_tpu as mx
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = (d.data.astype(np.float32) / 16.0)
+    y = d.target.astype(np.float32)
+    rng = np.random.RandomState(7)
+    idx = rng.permutation(len(y))
+    x, y = x[idx], y[idx]
+    img = np.kron(x.reshape(-1, 8, 8),
+                  np.ones((1, 4, 4), np.float32))[:, None]
+    xt, yt, xv, yv = img[:1437], y[:1437], img[1437:], y[1437:]
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=50, name="c2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=256,
+                                name="f1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="f2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(xt, yt, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    vit = mx.io.NDArrayIter(xv, yv, batch_size=64,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.tpu(0))
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    vit.reset()
+    return float(dict(mod.score(vit, mx.metric.Accuracy()))["accuracy"])
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -160,6 +203,13 @@ def main():
                        else RN50_FWD_FLOPS_PER_IMG)
     infer_mfu = infer16_ips * infer_flops_img / V5E_PEAK_FLOPS
 
+    # accuracy lane last but guarded: a missing sklearn or a lane failure
+    # must not discard the timing results measured above
+    try:
+        acc_lane = round(_accuracy_lane(), 4)
+    except Exception as e:
+        acc_lane = f"unavailable: {type(e).__name__}"
+
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(train_ips, 2),
@@ -180,6 +230,7 @@ def main():
         "inference_bf16_vs_baseline": round(
             infer16_ips / K80_RN50_INFER_B32, 2),
         "vs_k80_resnet152_train": round(train_ips / K80_RN152_TRAIN, 2),
+        "accuracy_lane_lenet_digits_val_acc": acc_lane,
         "timing": "median-of-3x20-steps",
     }))
 

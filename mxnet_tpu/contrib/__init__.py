@@ -6,3 +6,4 @@ from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import torch_bridge  # noqa: F401
+from . import onnx  # noqa: F401

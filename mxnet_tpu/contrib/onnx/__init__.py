@@ -1,0 +1,14 @@
+"""ONNX model import (parity: python/mxnet/contrib/onnx/_import).
+
+`import_model(model_file) -> (sym, arg_params, aux_params)` — the
+reference's entry point (contrib/onnx/_import/import_model.py:24). The
+zero-dependency design: this image carries neither the `onnx` package nor
+protoc-generated bindings, so `onnx_proto.py` implements the small
+protobuf wire-format subset ONNX files use (ModelProto/GraphProto/
+NodeProto/TensorProto), and `import_onnx.py` translates the graph onto
+mx.sym operators (reference op map: op_translations.py).
+"""
+from .import_model import import_model, get_model_metadata
+from .import_onnx import GraphProto
+
+__all__ = ["import_model", "get_model_metadata", "GraphProto"]

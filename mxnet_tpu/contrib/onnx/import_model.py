@@ -1,0 +1,37 @@
+"""ONNX entry points.
+
+Parity target: python/mxnet/contrib/onnx/_import/import_model.py:24
+(`import_model(model_file) -> (sym, arg_params, aux_params)`) and
+`get_model_metadata` (input/output tensor names+shapes).
+"""
+from __future__ import annotations
+
+from . import onnx_proto
+from .import_onnx import GraphProto
+
+__all__ = ["import_model", "get_model_metadata"]
+
+
+def import_model(model_file):
+    """Import an ONNX model file into a Symbol + parameter dicts.
+
+    Returns (sym, arg_params, aux_params): `sym` composes registered
+    mx.sym operators; `arg_params` holds the translated initializers
+    (conv/FC weights, biases, BN gamma/beta); `aux_params` the BN running
+    statistics. Bind like any native symbol:
+
+        sym, arg, aux = mx.contrib.onnx.import_model("model.onnx")
+        mod = mx.mod.Module(sym, data_names=[...], label_names=None)
+    """
+    model = onnx_proto.load_model(model_file)
+    return GraphProto().from_onnx(model.graph, opset=model.opset)
+
+
+def get_model_metadata(model_file):
+    """Input/output tensor metadata of an ONNX file without binding it:
+    {'input_tensor_data': [(name, shape)...],
+     'output_tensor_data': [(name, shape)...]}."""
+    model = onnx_proto.load_model(model_file)
+    g = GraphProto()
+    g.from_onnx(model.graph, opset=model.opset)
+    return g.model_metadata

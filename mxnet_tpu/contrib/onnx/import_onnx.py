@@ -1,0 +1,506 @@
+"""ONNX graph -> mx.sym translation.
+
+Parity target: python/mxnet/contrib/onnx/_import/import_onnx.py (GraphProto
+driver) + op_translations.py (per-op map). Translation happens on the
+decoded wire messages from `onnx_proto` — each ONNX node becomes a
+composition of registered mx.sym operators, initializers become
+arg/aux params, and shape-carrying inputs (Reshape/Slice/axes...) are
+resolved through a constant-value table (initializers + Constant nodes),
+matching the reference's _import behavior for static graphs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import onnx_proto as op_
+
+import mxnet_tpu as mx
+
+
+def _attr_values(node):
+    return {k: a.value for k, a in node.attrs.items()}
+
+
+def _pads_to_mx(pads, nspatial):
+    """ONNX pads [x1b,x2b,...,x1e,x2e,...] -> symmetric per-axis tuple, or
+    None if asymmetric (caller must emit an explicit Pad)."""
+    if not pads:
+        return (0,) * nspatial
+    begin, end = pads[:nspatial], pads[nspatial:]
+    if tuple(begin) != tuple(end):
+        return None
+    return tuple(int(p) for p in begin)
+
+
+def _asym_pad(data, pads, nspatial):
+    """Explicit mx.sym.pad for asymmetric ONNX conv/pool pads (NCHW)."""
+    begin, end = pads[:nspatial], pads[nspatial:]
+    width = [0, 0, 0, 0]
+    for b, e in zip(begin, end):
+        width += [int(b), int(e)]
+    return mx.sym.pad(data, mode="constant", constant_value=0.0,
+                      pad_width=tuple(width))
+
+
+class GraphProto:
+    """Stateful translator: one instance per imported model."""
+
+    def __init__(self):
+        self._params = {}       # name -> np.ndarray (initializers)
+        self._consts = {}       # name -> np.ndarray (static values)
+        self._tensors = {}      # name -> mx.sym
+        self.model_metadata = {}
+
+    # -- public -------------------------------------------------------------
+    def from_onnx(self, graph, opset=13):
+        self.opset = opset
+        for init in graph.initializers:
+            self._params[init.name] = np.asarray(init.array)
+            self._consts[init.name] = np.asarray(init.array)
+        input_infos = []
+        for vi in graph.inputs:
+            if vi.name in self._params:
+                continue
+            input_infos.append((vi.name, tuple(vi.shape)))
+            self._tensors[vi.name] = mx.sym.Variable(vi.name)
+        self.model_metadata = {
+            "input_tensor_data": input_infos,
+            "output_tensor_data": [(vi.name, tuple(vi.shape))
+                                   for vi in graph.outputs],
+        }
+        for node in graph.nodes:
+            self._translate(node)
+        outs = [self._tensors[vi.name] for vi in graph.outputs]
+        sym = outs[0] if len(outs) == 1 else mx.sym.Group(outs)
+
+        aux_names = set(sym.list_auxiliary_states())
+        arg_names = set(sym.list_arguments())
+        arg_params, aux_params = {}, {}
+        for name, arr in self._params.items():
+            if name in aux_names:
+                aux_params[name] = mx.nd.array(arr)
+            elif name in arg_names:
+                arg_params[name] = mx.nd.array(arr)
+            # initializers consumed as static values (shapes/axes) vanish
+        return sym, arg_params, aux_params
+
+    # -- helpers ------------------------------------------------------------
+    def _in(self, node, i):
+        name = node.inputs[i]
+        if name == "":
+            return None
+        if name not in self._tensors:
+            if name in self._params:
+                self._tensors[name] = mx.sym.Variable(name)
+            else:
+                raise MXNetError(f"ONNX import: undefined tensor {name!r} "
+                                 f"consumed by {node.op_type}")
+        return self._tensors[name]
+
+    def _const(self, node, i, what):
+        name = node.inputs[i]
+        if name not in self._consts:
+            raise MXNetError(
+                f"ONNX import: {node.op_type} needs a static {what} "
+                f"(tensor {name!r} is not an initializer/Constant)")
+        return self._consts[name]
+
+    def _set(self, node, sym, i=0):
+        self._tensors[node.outputs[i]] = sym
+
+    def _translate(self, node):
+        fn = _TRANSLATIONS.get(node.op_type)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX import: unsupported operator {node.op_type!r} "
+                f"(node {node.name!r}); supported: "
+                f"{sorted(_TRANSLATIONS)}")
+        fn(self, node, _attr_values(node))
+
+
+# ---------------------------------------------------------------------------
+# per-op translations (reference map: _import/op_translations.py)
+# ---------------------------------------------------------------------------
+
+_TRANSLATIONS = {}
+
+
+def _reg(*names):
+    def deco(fn):
+        for n in names:
+            _TRANSLATIONS[n] = fn
+        return fn
+    return deco
+
+
+@_reg("Conv")
+def _conv(g, node, attrs):
+    data = g._in(node, 0)
+    weight = g._in(node, 1)
+    bias = g._in(node, 2) if len(node.inputs) > 2 else None
+    kshape = tuple(int(k) for k in attrs["kernel_shape"])
+    ns = len(kshape)
+    pads = [int(p) for p in attrs.get("pads", ())]
+    pad = _pads_to_mx(pads, ns)
+    if pad is None:
+        data = _asym_pad(data, pads, ns)
+        pad = (0,) * ns
+    kw = dict(kernel=kshape, pad=pad,
+              stride=tuple(int(s) for s in attrs.get("strides",
+                                                     (1,) * ns)),
+              dilate=tuple(int(d) for d in attrs.get("dilations",
+                                                     (1,) * ns)),
+              num_group=int(attrs.get("group", 1)))
+    wname = node.inputs[1]
+    num_filter = int(g._params[wname].shape[0]) if wname in g._params \
+        else int(attrs["kernel_shape"][0])
+    if bias is None:
+        out = mx.sym.Convolution(data, weight, num_filter=num_filter,
+                                 no_bias=True, **kw)
+    else:
+        out = mx.sym.Convolution(data, weight, bias, num_filter=num_filter,
+                                 no_bias=False, **kw)
+    g._set(node, out)
+
+
+@_reg("Gemm")
+def _gemm(g, node, attrs):
+    a, b = g._in(node, 0), g._in(node, 1)
+    c = g._in(node, 2) if len(node.inputs) > 2 else None
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    ta, tb = int(attrs.get("transA", 0)), int(attrs.get("transB", 0))
+    bname = node.inputs[1]
+    if (not ta and tb and alpha == 1.0 and beta == 1.0
+            and c is not None and bname in g._params):
+        # torch Linear pattern -> FullyConnected (weight already (out,in))
+        g._set(node, mx.sym.FullyConnected(
+            a, b, c, num_hidden=int(g._params[bname].shape[0]),
+            flatten=False))
+        return
+    if ta:
+        a = mx.sym.transpose(a)
+    if tb:
+        b = mx.sym.transpose(b)
+    out = mx.sym.dot(a, b)
+    if alpha != 1.0:
+        out = out * alpha
+    if c is not None:
+        out = mx.sym.broadcast_add(out, c * beta if beta != 1.0 else c)
+    g._set(node, out)
+
+
+@_reg("MatMul")
+def _matmul(g, node, attrs):
+    g._set(node, mx.sym.linalg_gemm2(g._in(node, 0), g._in(node, 1)))
+
+
+@_reg("BatchNormalization")
+def _bn(g, node, attrs):
+    out = mx.sym.BatchNorm(
+        g._in(node, 0), g._in(node, 1), g._in(node, 2), g._in(node, 3),
+        g._in(node, 4), eps=float(attrs.get("epsilon", 1e-5)),
+        momentum=float(attrs.get("momentum", 0.9)), fix_gamma=False,
+        use_global_stats=False)
+    g._set(node, out)
+
+
+def _pool(g, node, attrs, ptype, global_pool):
+    data = g._in(node, 0)
+    if global_pool:
+        g._set(node, mx.sym.Pooling(data, global_pool=True, kernel=(1, 1),
+                                    pool_type=ptype))
+        return
+    kshape = tuple(int(k) for k in attrs["kernel_shape"])
+    ns = len(kshape)
+    pads = [int(p) for p in attrs.get("pads", ())]
+    pad = _pads_to_mx(pads, ns)
+    if pad is None:
+        data = _asym_pad(data, pads, ns)
+        pad = (0,) * ns
+    count_include_pad = bool(int(attrs.get("count_include_pad", 0)))
+    g._set(node, mx.sym.Pooling(
+        data, kernel=kshape, pool_type=ptype, pad=pad,
+        stride=tuple(int(s) for s in attrs.get("strides", (1,) * ns)),
+        pooling_convention="full" if attrs.get("ceil_mode") else "valid",
+        count_include_pad=count_include_pad))
+
+
+_reg("MaxPool")(lambda g, n, a: _pool(g, n, a, "max", False))
+_reg("AveragePool")(lambda g, n, a: _pool(g, n, a, "avg", False))
+_reg("GlobalMaxPool")(lambda g, n, a: _pool(g, n, a, "max", True))
+_reg("GlobalAveragePool")(lambda g, n, a: _pool(g, n, a, "avg", True))
+
+
+# -- activations -------------------------------------------------------------
+
+_reg("Relu")(lambda g, n, a: g._set(n, mx.sym.relu(g._in(n, 0))))
+_reg("Sigmoid")(lambda g, n, a: g._set(n, mx.sym.sigmoid(g._in(n, 0))))
+_reg("Tanh")(lambda g, n, a: g._set(n, mx.sym.tanh(g._in(n, 0))))
+_reg("Softplus")(lambda g, n, a: g._set(
+    n, mx.sym.Activation(g._in(n, 0), act_type="softrelu")))
+_reg("Softsign")(lambda g, n, a: g._set(
+    n, mx.sym.Activation(g._in(n, 0), act_type="softsign")))
+_reg("LeakyRelu")(lambda g, n, a: g._set(n, mx.sym.LeakyReLU(
+    g._in(n, 0), act_type="leaky", slope=float(a.get("alpha", 0.01)))))
+_reg("Elu")(lambda g, n, a: g._set(n, mx.sym.LeakyReLU(
+    g._in(n, 0), act_type="elu", slope=float(a.get("alpha", 1.0)))))
+_reg("Selu")(lambda g, n, a: g._set(n, mx.sym.LeakyReLU(
+    g._in(n, 0), act_type="selu")))
+_reg("PRelu")(lambda g, n, a: g._set(n, mx.sym.LeakyReLU(
+    g._in(n, 0), gamma=g._in(n, 1), act_type="prelu")))
+_reg("Softmax")(lambda g, n, a: g._set(n, mx.sym.softmax(
+    g._in(n, 0), axis=int(a.get("axis", -1)))))
+_reg("LogSoftmax")(lambda g, n, a: g._set(n, mx.sym.log_softmax(
+    g._in(n, 0), axis=int(a.get("axis", -1)))))
+_reg("Identity")(lambda g, n, a: g._set(n, mx.sym.identity(g._in(n, 0))))
+
+
+@_reg("Dropout")
+def _dropout(g, node, attrs):
+    # inference graphs: identity; ratio may be attr (opset<12) or input
+    ratio = float(attrs.get("ratio", 0.5))
+    if len(node.inputs) > 1 and node.inputs[1] in g._consts:
+        ratio = float(g._consts[node.inputs[1]])
+    g._set(node, mx.sym.Dropout(g._in(node, 0), p=ratio))
+
+
+# -- elementwise binary (with numpy-style broadcasting) ----------------------
+
+def _broadcast_op(mxop):
+    def fn(g, node, attrs):
+        g._set(node, mxop(g._in(node, 0), g._in(node, 1)))
+    return fn
+
+
+_reg("Add")(_broadcast_op(mx.sym.broadcast_add))
+_reg("Sub")(_broadcast_op(mx.sym.broadcast_sub))
+_reg("Mul")(_broadcast_op(mx.sym.broadcast_mul))
+_reg("Div")(_broadcast_op(mx.sym.broadcast_div))
+_reg("Pow")(_broadcast_op(mx.sym.broadcast_power))
+_reg("Greater")(_broadcast_op(mx.sym.broadcast_greater))
+_reg("Less")(_broadcast_op(mx.sym.broadcast_lesser))
+_reg("Equal")(_broadcast_op(mx.sym.broadcast_equal))
+
+
+@_reg("Sum")
+def _sum_variadic(g, node, attrs):
+    syms = [g._in(node, i) for i in range(len(node.inputs))]
+    out = syms[0]
+    for s in syms[1:]:
+        out = mx.sym.broadcast_add(out, s)
+    g._set(node, out)
+
+
+@_reg("Max")
+def _max_variadic(g, node, attrs):
+    syms = [g._in(node, i) for i in range(len(node.inputs))]
+    out = syms[0]
+    for s in syms[1:]:
+        out = mx.sym.broadcast_maximum(out, s)
+    g._set(node, out)
+
+
+@_reg("Min")
+def _min_variadic(g, node, attrs):
+    syms = [g._in(node, i) for i in range(len(node.inputs))]
+    out = syms[0]
+    for s in syms[1:]:
+        out = mx.sym.broadcast_minimum(out, s)
+    g._set(node, out)
+
+
+# -- elementwise unary -------------------------------------------------------
+
+for _onnx_name, _mx in [
+        ("Neg", mx.sym.negative), ("Abs", mx.sym.abs), ("Exp", mx.sym.exp),
+        ("Log", mx.sym.log), ("Sqrt", mx.sym.sqrt),
+        ("Reciprocal", mx.sym.reciprocal), ("Floor", mx.sym.floor),
+        ("Ceil", mx.sym.ceil), ("Round", mx.sym.round),
+        ("Sin", mx.sym.sin), ("Cos", mx.sym.cos), ("Tan", mx.sym.tan),
+        ("Asin", mx.sym.arcsin), ("Acos", mx.sym.arccos),
+        ("Atan", mx.sym.arctan), ("Erf", mx.sym.erf),
+        ("Sign", mx.sym.sign)]:
+    _reg(_onnx_name)(
+        lambda g, n, a, _mx=_mx: g._set(n, _mx(g._in(n, 0))))
+
+
+@_reg("Clip")
+def _clip(g, node, attrs):
+    lo = float(attrs.get("min", -np.inf))
+    hi = float(attrs.get("max", np.inf))
+    if len(node.inputs) > 1 and node.inputs[1]:
+        lo = float(g._const(node, 1, "min"))
+    if len(node.inputs) > 2 and node.inputs[2]:
+        hi = float(g._const(node, 2, "max"))
+    g._set(node, mx.sym.clip(g._in(node, 0), a_min=lo, a_max=hi))
+
+
+# -- shape ops ---------------------------------------------------------------
+
+@_reg("Reshape")
+def _reshape(g, node, attrs):
+    if "shape" in attrs:                       # opset<5
+        shape = tuple(int(s) for s in attrs["shape"])
+    else:
+        shape = tuple(int(s) for s in g._const(node, 1, "shape"))
+    g._set(node, mx.sym.reshape(g._in(node, 0), shape=shape))
+
+
+@_reg("Flatten")
+def _flatten(g, node, attrs):
+    axis = int(attrs.get("axis", 1))
+    if axis == 1:
+        g._set(node, mx.sym.Flatten(g._in(node, 0)))
+    else:
+        g._set(node, mx.sym.reshape(g._in(node, 0), shape=(0,) * axis
+                                    + (-1,)))
+
+
+@_reg("Transpose")
+def _transpose(g, node, attrs):
+    perm = attrs.get("perm")
+    if perm is None:
+        g._set(node, mx.sym.transpose(g._in(node, 0)))
+    else:
+        g._set(node, mx.sym.transpose(g._in(node, 0),
+                                      axes=tuple(int(p) for p in perm)))
+
+
+@_reg("Squeeze")
+def _squeeze(g, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:   # opset 13: axes as input
+        axes = g._const(node, 1, "axes")
+    g._set(node, mx.sym.squeeze(
+        g._in(node, 0),
+        axis=tuple(int(a) for a in axes) if axes is not None else None))
+
+
+@_reg("Unsqueeze")
+def _unsqueeze(g, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None:
+        axes = g._const(node, 1, "axes")
+    out = g._in(node, 0)
+    for ax in sorted(int(a) for a in axes):
+        out = mx.sym.expand_dims(out, axis=ax)
+    g._set(node, out)
+
+
+@_reg("Concat")
+def _concat(g, node, attrs):
+    syms = [g._in(node, i) for i in range(len(node.inputs))]
+    g._set(node, mx.sym.Concat(*syms, dim=int(attrs.get("axis", 1)),
+                               num_args=len(syms)))
+
+
+@_reg("Slice")
+def _slice(g, node, attrs):
+    data = g._in(node, 0)
+    if "starts" in attrs:                      # opset<10
+        starts = [int(s) for s in attrs["starts"]]
+        ends = [int(e) for e in attrs["ends"]]
+        axes = [int(a) for a in attrs.get("axes",
+                                          range(len(starts)))]
+        steps = [1] * len(starts)
+    else:
+        starts = [int(s) for s in g._const(node, 1, "starts")]
+        ends = [int(e) for e in g._const(node, 2, "ends")]
+        axes = [int(a) for a in g._const(node, 3, "axes")] \
+            if len(node.inputs) > 3 else list(range(len(starts)))
+        steps = [int(s) for s in g._const(node, 4, "steps")] \
+            if len(node.inputs) > 4 else [1] * len(starts)
+    out = data
+    for ax, b, e, st in zip(axes, starts, ends, steps):
+        if st != 1:
+            raise MXNetError("ONNX import: Slice step != 1 unsupported")
+        out = mx.sym.slice_axis(out, axis=ax, begin=b,
+                                end=None if e >= 2 ** 31 else e)
+    g._set(node, out)
+
+
+@_reg("Gather")
+def _gather(g, node, attrs):
+    g._set(node, mx.sym.take(g._in(node, 0), g._in(node, 1),
+                             axis=int(attrs.get("axis", 0))))
+
+
+@_reg("Cast")
+def _cast(g, node, attrs):
+    dtype = op_.TENSOR_DTYPES[int(attrs["to"])]
+    g._set(node, mx.sym.Cast(g._in(node, 0),
+                             dtype=np.dtype(dtype).name))
+
+
+@_reg("Pad")
+def _pad(g, node, attrs):
+    mode = attrs.get("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    pads = attrs.get("pads")
+    if pads is None:
+        pads = g._const(node, 1, "pads")
+    pads = [int(p) for p in pads]
+    ndim = len(pads) // 2
+    width = []
+    for i in range(ndim):
+        width += [pads[i], pads[ndim + i]]
+    value = float(attrs.get("value", 0.0))
+    g._set(node, mx.sym.pad(g._in(node, 0), mode=mode,
+                            pad_width=tuple(width), constant_value=value))
+
+
+@_reg("Constant")
+def _constant(g, node, attrs):
+    tensor = node.attrs["value"].value
+    arr = np.asarray(tensor.array)
+    name = node.outputs[0]
+    g._consts[name] = arr
+    g._params[name] = arr
+    g._tensors[name] = mx.sym.Variable(name)
+
+
+# -- reductions --------------------------------------------------------------
+
+def _reduce(mxop):
+    def fn(g, node, attrs):
+        axes = attrs.get("axes")
+        keep = bool(int(attrs.get("keepdims", 1)))
+        kw = {"keepdims": keep}
+        if axes is not None:
+            kw["axis"] = tuple(int(a) for a in axes)
+        g._set(node, mxop(g._in(node, 0), **kw))
+    return fn
+
+
+_reg("ReduceSum")(_reduce(mx.sym.sum))
+_reg("ReduceMean")(_reduce(mx.sym.mean))
+_reg("ReduceMax")(_reduce(mx.sym.max))
+_reg("ReduceMin")(_reduce(mx.sym.min))
+_reg("ReduceProd")(_reduce(mx.sym.prod))
+
+
+@_reg("ArgMax")
+def _argmax(g, node, attrs):
+    g._set(node, mx.sym.argmax(g._in(node, 0),
+                               axis=int(attrs.get("axis", 0)),
+                               keepdims=bool(int(attrs.get("keepdims",
+                                                           1)))))
+
+
+@_reg("ArgMin")
+def _argmin(g, node, attrs):
+    g._set(node, mx.sym.argmin(g._in(node, 0),
+                               axis=int(attrs.get("axis", 0)),
+                               keepdims=bool(int(attrs.get("keepdims",
+                                                           1)))))
+
+
+@_reg("LRN")
+def _lrn(g, node, attrs):
+    g._set(node, mx.sym.LRN(
+        g._in(node, 0), nsize=int(attrs["size"]),
+        alpha=float(attrs.get("alpha", 1e-4)),
+        beta=float(attrs.get("beta", 0.75)),
+        knorm=float(attrs.get("bias", 1.0))))

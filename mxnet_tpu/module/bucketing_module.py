@@ -7,6 +7,14 @@ switches on the batch's bucket_key. On TPU each bucket is its own compiled
 XLA program (the executor-cache role of the reference's shape-keyed
 CachedOp/executor sharing) — jit caching makes switching free after first
 compile.
+
+NOTE on similarity to the reference: BucketingModule is a pure dispatch
+facade — every public method forwards to the current bucket's Module with
+the reference's documented argument-plumbing (shared-param binding against
+the default bucket, switch_bucket on each batch's bucket_key, the
+fixed set of BaseModule overrides). That delegation skeleton is the API;
+the executor machinery it switches between (per-bucket jitted programs)
+is this project's own design.
 """
 from __future__ import annotations
 

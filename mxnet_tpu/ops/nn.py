@@ -523,11 +523,10 @@ def _bn_train(data, gamma, beta, axis, eps, fix_gamma, relu):
     """Training-mode BN core: returns (out, batch_mean, batch_var).
 
     Hand-written vjp for HBM-roofline reasons (docs/perf_analysis_r03.md):
-    the forward computes mean and E[x^2] in ONE pass so XLA fuses both
-    reductions into the producing conv's epilogue, and the backward does the
-    minimal two passes (one for the dgamma/dbeta sums, one for dx) instead
-    of autodiff's mean->var dependency chain. Stats accumulate in fp32
-    regardless of the activation dtype. `relu` folds a following
+    the backward does the minimal two passes (one for the dgamma/dbeta
+    sums, one for dx) instead of autodiff's mean->var dependency chain.
+    Stats accumulate in fp32 regardless of the activation dtype (stable
+    two-pass variance — see _bn_stats). `relu` folds a following
     Activation('relu') node into the kernel (executor BN+ReLU fusion pass):
     the backward masks dy inline instead of paying a separate full
     read+write pass over the activation tensor.
@@ -536,9 +535,16 @@ def _bn_train(data, gamma, beta, axis, eps, fix_gamma, relu):
 
 
 def _bn_stats(data, red_axes):
+    # two-pass variance (mean first, then E[(x-mean)^2]) — the one-pass
+    # E[x^2]-mean^2 form cancels catastrophically when |mean| >> std
+    # (measured: fp32 data with mean 1e3/std 1e-2 yields var=-0.19 -> NaN
+    # through rsqrt; the reference's CPU BN is two-pass for the same
+    # reason). Costs ~4% ResNet-50 step time vs one-pass; correctness wins.
     m = jnp.mean(data, axis=red_axes, dtype=jnp.float32)
-    m2 = jnp.mean(jax.lax.square(data), axis=red_axes, dtype=jnp.float32)
-    return m, m2 - jax.lax.square(m)
+    bshape = tuple(1 if i in red_axes else s
+                   for i, s in enumerate(data.shape))
+    d = data.astype(jnp.float32) - m.reshape(bshape)
+    return m, jnp.mean(jax.lax.square(d), axis=red_axes)
 
 
 def _bn_train_fwd(data, gamma, beta, axis, eps, fix_gamma, relu):

@@ -59,25 +59,46 @@ def _p(**extra):
 
 # -- SGD ---------------------------------------------------------------------
 
+def _row_mask(grad):
+    """Rows "touched" by a row_sparse gradient, dense-backed: any nonzero
+    in the row (matches RowSparseNDArray.indices). Broadcastable mask."""
+    axes = tuple(range(1, grad.ndim))
+    touched = jnp.any(grad != 0, axis=axes) if axes else (grad != 0)
+    return touched.reshape((-1,) + (1,) * (grad.ndim - 1))
+
+
+def _lazy(attrs, grad, new, old):
+    """reference lazy_update semantics (src/operator/optimizer_op.cc sparse
+    sgd/adam kernels): with a row_sparse grad and lazy_update=True, ONLY
+    rows present in grad.indices are updated — untouched rows skip weight
+    decay, momentum decay and moment updates entirely. The optimizer
+    frontend sets the attr only when grad.stype == 'row_sparse'."""
+    if not attrs.get("lazy_update"):
+        return new
+    m = _row_mask(grad)
+    return tuple(jnp.where(m, n, o) for n, o in zip(new, old))
+
+
 def _sgd_update(attrs, octx, weight, grad):
     g = _prep(attrs, grad, weight)
-    return (weight - jnp.asarray(attrs.lr, weight.dtype) * g,)
+    new_w = weight - jnp.asarray(attrs.lr, weight.dtype) * g
+    return _lazy(attrs, grad, (new_w,), (weight,))
 
 
-register("sgd_update", _sgd_update, params=_p(),
-         inputs=("weight", "grad"),
-         # lazy_update only matters for row_sparse grads (dense on TPU)
-         aliases=())
+register("sgd_update", _sgd_update,
+         params=dict(_p(), lazy_update=Param("bool", False)),
+         inputs=("weight", "grad"), aliases=())
 
 
 def _sgd_mom_update(attrs, octx, weight, grad, mom):
     g = _prep(attrs, grad, weight)
     lr = jnp.asarray(attrs.lr, weight.dtype)
     new_mom = jnp.asarray(attrs.momentum, mom.dtype) * mom - lr * g
-    return (weight + new_mom, new_mom)
+    return _lazy(attrs, grad, (weight + new_mom, new_mom), (weight, mom))
 
 
-register("sgd_mom_update", _sgd_mom_update, params=_p(momentum=0.0),
+register("sgd_mom_update", _sgd_mom_update,
+         params=dict(_p(momentum=0.0), lazy_update=Param("bool", False)),
          inputs=("weight", "grad", "mom"), aux=("mom",),
          mutates_aux=True, aux_always=True)
 
@@ -115,11 +136,13 @@ def _adam_update(attrs, octx, weight, grad, mean, var):
     new_var = b2 * var + (1 - b2) * jnp.square(g)
     step = jnp.asarray(attrs.lr, weight.dtype) * new_mean / (
         jnp.sqrt(new_var) + jnp.asarray(attrs.epsilon, weight.dtype))
-    return (weight - step, new_mean, new_var)
+    return _lazy(attrs, grad, (weight - step, new_mean, new_var),
+                 (weight, mean, var))
 
 
 register("adam_update", _adam_update,
-         params=_p(beta1=0.9, beta2=0.999, epsilon=1e-8),
+         params=dict(_p(beta1=0.9, beta2=0.999, epsilon=1e-8),
+                     lazy_update=Param("bool", False)),
          inputs=("weight", "grad", "mean", "var"), aux=("mean", "var"),
          mutates_aux=True, aux_always=True)
 

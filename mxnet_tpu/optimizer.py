@@ -216,12 +216,16 @@ class SGD(Optimizer):
             kwargs["momentum"] = self.momentum
 
         if not multi_precision:
+            # lazy_update engages only for row_sparse grads (reference
+            # optimizer.py:498: stype = weight.stype if lazy_update):
+            # untouched rows skip decay/momentum (ops/optimizer_ops.py:_lazy)
+            lazy = self.lazy_update and grad.stype == "row_sparse"
             if state is not None:
                 ndns.sgd_mom_update(weight, grad, state, out=weight,
-                                    lr=lr, wd=wd, **kwargs)
+                                    lr=lr, wd=wd, lazy_update=lazy, **kwargs)
             else:
                 ndns.sgd_update(weight, grad, out=weight, lr=lr, wd=wd,
-                                **kwargs)
+                                lazy_update=lazy, **kwargs)
         else:
             if state[0] is not None:
                 ndns.mp_sgd_mom_update(weight, grad, state[0], state[1],
@@ -418,9 +422,11 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        lazy = self.lazy_update and grad.stype == "row_sparse"
         ndns.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
                          beta1=self.beta1, beta2=self.beta2,
-                         epsilon=self.epsilon, **_common_kwargs(self, index))
+                         epsilon=self.epsilon, lazy_update=lazy,
+                         **_common_kwargs(self, index))
 
 
 @register

@@ -1,0 +1,132 @@
+"""Multi-process Module.fit end-to-end through the dist kvstore.
+
+Reference pattern: tests/nightly/dist_lenet.py — N real worker processes
+(tools/launch.py local launcher) train the same model via `Module.fit`
+with kv_store='dist_sync', then final parameters are checked against a
+single-process run. Parity holds exactly because dist-sync sums worker
+gradients: worker r training on data[r::N] with batch B sees, at step k,
+the index set {r + N*i : i in [kB,(k+1)B)} whose union over r is the
+contiguous block [N*kB, N*(k+1)B) — i.e. the same global batches as one
+process with batch N*B over the unsharded data.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_WORKERS = 2
+BATCH = 8
+EPOCHS = 2
+LR = 0.1
+
+_COMMON = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+BATCH = int(os.environ["T_BATCH"])
+EPOCHS = int(os.environ["T_EPOCHS"])
+LR = float(os.environ["T_LR"])
+NW = int(os.environ["T_NW"])
+
+
+def mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def dataset():
+    rng = np.random.RandomState(42)
+    n = 64
+    y = rng.randint(0, 4, n).astype(np.float32)
+    x = rng.normal(0, 0.5, (n, 8)).astype(np.float32)
+    for i in range(n):
+        x[i, int(y[i]) * 2] += 2.0
+    return x, y
+
+
+def run_fit(x, y, batch, kv):
+    mx.random.seed(7)   # identical Xavier draws in every process
+    it = mx.io.NDArrayIter(x, y, batch_size=batch, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp(), context=mx.cpu())
+    # rescale by the GLOBAL batch (NW*BATCH) in both runs: dist workers
+    # each see BATCH samples and their grads sum across the store
+    mod.fit(it, num_epoch=EPOCHS, optimizer="sgd", kvstore=kv,
+            optimizer_params={"learning_rate": LR,
+                              "rescale_grad": 1.0 / (NW * BATCH)},
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+"""
+
+WORKER = _COMMON + r"""
+rank = int(os.environ["DMLC_WORKER_ID"])
+out_dir = sys.argv[1]
+x, y = dataset()
+params = run_fit(x[rank::NW], y[rank::NW], BATCH, "dist_sync")
+if rank == 0:
+    np.savez(os.path.join(out_dir, "dist_params.npz"), **params)
+with open(os.path.join(out_dir, f"fit_ok_{rank}"), "w") as f:
+    f.write("pass")
+print(f"worker {rank}: PASS", flush=True)
+"""
+
+SINGLE = _COMMON + r"""
+out_dir = sys.argv[1]
+x, y = dataset()
+params = run_fit(x, y, NW * BATCH, "local")
+np.savez(os.path.join(out_dir, "single_params.npz"), **params)
+"""
+
+
+def _fmt(tpl):
+    return tpl
+
+
+def test_dist_module_fit_matches_single_process():
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_NUM_CPU_DEVICES"] = "1"
+        env.update(T_BATCH=str(BATCH), T_EPOCHS=str(EPOCHS), T_LR=str(LR),
+                   T_NW=str(N_WORKERS))
+
+        single = os.path.join(td, "single.py")
+        with open(single, "w") as f:
+            f.write(_fmt(SINGLE))
+        proc = subprocess.run([sys.executable, single, td], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"single-process run failed:\n{proc.stdout}\n{proc.stderr}"
+
+        worker = os.path.join(td, "worker.py")
+        with open(worker, "w") as f:
+            f.write(_fmt(WORKER))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", str(N_WORKERS), "--launcher", "local",
+             sys.executable, worker, td],
+            env=env, capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, \
+            f"dist run failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        for r in range(N_WORKERS):
+            assert os.path.exists(os.path.join(td, f"fit_ok_{r}")), \
+                f"worker {r} did not finish:\n{proc.stdout}\n{proc.stderr}"
+
+        dist = np.load(os.path.join(td, "dist_params.npz"))
+        ref = np.load(os.path.join(td, "single_params.npz"))
+        assert set(dist.files) == set(ref.files)
+        for k in ref.files:
+            np.testing.assert_allclose(
+                dist[k], ref[k], rtol=1e-4, atol=1e-5,
+                err_msg=f"dist-vs-single mismatch in {k}")

@@ -29,7 +29,6 @@ def _imperative_ref(args, with_bias, fix_gamma):
     for v in nds.values():
         v.attach_grad()
     with autograd.record():
-        kw = {} if with_bias else {}
         if with_bias:
             y = mx.nd.Convolution(nds["x"], nds["conv_weight"],
                                   nds["conv_bias"], kernel=(3, 3),
@@ -75,6 +74,31 @@ def test_fused_executor_matches_imperative(with_bias, fix_gamma):
         np.testing.assert_allclose(
             exe.grad_dict[k].asnumpy(), ref_grads[k].asnumpy(),
             rtol=2e-3, atol=2e-3, err_msg=f"grad mismatch for {k}")
+
+
+def test_bn_stats_stable_for_large_mean():
+    """Two-pass BN variance must stay finite and accurate when
+    |mean| >> std — the one-pass E[x^2]-mean^2 form goes negative here
+    (var -0.19 measured for mean 1e3/std 1e-2) and NaNs through rsqrt
+    (code-review regression)."""
+    rng = np.random.RandomState(0)
+    x = (1000.0 + 0.01 * rng.normal(size=(8, 3, 16, 16))).astype(np.float32)
+    data = mx.nd.array(x)
+    gamma = mx.nd.ones((3,))
+    beta = mx.nd.zeros((3,))
+    mm, mv = mx.nd.zeros((3,)), mx.nd.ones((3,))
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.BatchNorm(data, gamma, beta, mm, mv, fix_gamma=False,
+                              eps=1e-5)
+        s = mx.nd.sum(out * out)
+    s.backward(train_mode=True)
+    o = out.asnumpy()
+    assert np.isfinite(o).all(), "BN output non-finite for large-mean data"
+    assert np.isfinite(data.grad.asnumpy()).all()
+    # normalized output must be ~unit variance, not eps-collapsed
+    v = o.reshape(8, 3, -1).var(axis=(0, 2))
+    np.testing.assert_allclose(v, 1.0, rtol=0.1)
 
 
 def test_dead_bias_grad_is_zero():

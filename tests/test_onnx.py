@@ -1,0 +1,192 @@
+"""ONNX importer: wire-codec round-trip + forward parity against torch.
+
+Reference analog: tests/python-pytest/onnx (the reference imports ONNX
+files and checks forward outputs). This image has no `onnx` package and
+torch's exporter requires it, so fixture models are assembled with our own
+wire codec (`onnx_proto`) carrying weights taken FROM a torch module; the
+imported Symbol's forward must then match the torch module's forward —
+torch is the independent oracle for the translation semantics.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import import_model, get_model_metadata
+from mxnet_tpu.contrib.onnx import onnx_proto as op
+
+
+def _t(name, arr):
+    return op.Tensor(name, np.ascontiguousarray(arr))
+
+
+def _node(op_type, ins, outs, **attrs):
+    return op.Node(op_type, ins, outs,
+                   attrs={k: op.Attribute.make(k, v)
+                          for k, v in attrs.items()})
+
+
+def _model(nodes, inits, inputs, outputs):
+    g = op.Graph(nodes=nodes, initializers=inits, inputs=inputs,
+                 outputs=outputs)
+    return op.Model(g)
+
+
+def _forward(sym, arg_params, aux_params, feeds):
+    shapes = {k: v.shape for k, v in feeds.items()}
+    shapes.update({k: v.shape for k, v in arg_params.items()})
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    for k, v in feeds.items():
+        exe.arg_dict[k][:] = v
+    for k, v in arg_params.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in aux_params.items():
+        exe.aux_dict[k][:] = v.asnumpy()
+    return [o.asnumpy() for o in exe.forward(is_train=False)]
+
+
+def test_proto_roundtrip(tmp_path):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    m = _model(
+        [_node("Relu", ["x"], ["y"], )],
+        [_t("w", w)],
+        [op.ValueInfo("x", (2, 3))],
+        [op.ValueInfo("y", (2, 3))])
+    path = str(tmp_path / "m.onnx")
+    op.save_model(m, path)
+    m2 = op.load_model(path)
+    assert m2.graph.nodes[0].op_type == "Relu"
+    assert m2.graph.nodes[0].inputs == ["x"]
+    np.testing.assert_array_equal(m2.graph.initializers[0].array, w)
+    assert m2.graph.inputs[0].shape == (2, 3)
+
+
+def test_import_mlp_matches_torch(tmp_path):
+    torch.manual_seed(0)
+    net = tnn.Sequential(tnn.Linear(6, 16), tnn.ReLU(),
+                         tnn.Linear(16, 4)).eval()
+    w1 = net[0].weight.detach().numpy()
+    b1 = net[0].bias.detach().numpy()
+    w2 = net[2].weight.detach().numpy()
+    b2 = net[2].bias.detach().numpy()
+    m = _model(
+        [_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+         _node("Relu", ["h"], ["hr"]),
+         _node("Gemm", ["hr", "w2", "b2"], ["out"], transB=1),
+         _node("Softmax", ["out"], ["prob"], axis=-1)],
+        [_t("w1", w1), _t("b1", b1), _t("w2", w2), _t("b2", b2)],
+        [op.ValueInfo("x", (2, 6))],
+        [op.ValueInfo("prob", (2, 4))])
+    path = str(tmp_path / "mlp.onnx")
+    op.save_model(m, path)
+
+    sym, arg, aux = import_model(path)
+    assert not aux
+    x = np.random.RandomState(1).normal(0, 1, (2, 6)).astype(np.float32)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+    want = torch.softmax(net(torch.from_numpy(x)), dim=-1).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("x", (2, 6))]
+    assert meta["output_tensor_data"] == [("prob", (2, 4))]
+
+
+def test_import_convnet_with_bn_matches_torch(tmp_path):
+    torch.manual_seed(1)
+    net = tnn.Sequential(
+        tnn.Conv2d(1, 4, 3, padding=1), tnn.BatchNorm2d(4), tnn.ReLU(),
+        tnn.MaxPool2d(2), tnn.Conv2d(4, 8, 3), tnn.ReLU(),
+        tnn.AdaptiveAvgPool2d(1), tnn.Flatten(), tnn.Linear(8, 3))
+    # give BN non-trivial running stats, then freeze
+    net.train()
+    with torch.no_grad():
+        for _ in range(3):
+            net(torch.randn(8, 1, 12, 12))
+    net.eval()
+
+    conv1, bn, conv2, fc = net[0], net[1], net[4], net[8]
+    inits = [
+        _t("c1w", conv1.weight.detach().numpy()),
+        _t("c1b", conv1.bias.detach().numpy()),
+        _t("bng", bn.weight.detach().numpy()),
+        _t("bnb", bn.bias.detach().numpy()),
+        _t("bnm", bn.running_mean.detach().numpy()),
+        _t("bnv", bn.running_var.detach().numpy()),
+        _t("c2w", conv2.weight.detach().numpy()),
+        _t("c2b", conv2.bias.detach().numpy()),
+        _t("fcw", fc.weight.detach().numpy()),
+        _t("fcb", fc.bias.detach().numpy()),
+    ]
+    nodes = [
+        _node("Conv", ["x", "c1w", "c1b"], ["c1"], kernel_shape=[3, 3],
+              pads=[1, 1, 1, 1]),
+        _node("BatchNormalization", ["c1", "bng", "bnb", "bnm", "bnv"],
+              ["b1"], epsilon=float(bn.eps)),
+        _node("Relu", ["b1"], ["r1"]),
+        _node("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+              strides=[2, 2]),
+        _node("Conv", ["p1", "c2w", "c2b"], ["c2"], kernel_shape=[3, 3]),
+        _node("Relu", ["c2"], ["r2"]),
+        _node("GlobalAveragePool", ["r2"], ["gap"]),
+        _node("Flatten", ["gap"], ["fl"]),
+        _node("Gemm", ["fl", "fcw", "fcb"], ["out"], transB=1),
+    ]
+    m = _model(nodes, inits, [op.ValueInfo("x", (2, 1, 12, 12))],
+               [op.ValueInfo("out", (2, 3))])
+    path = str(tmp_path / "convnet.onnx")
+    op.save_model(m, path)
+
+    sym, arg, aux = import_model(path)
+    # BN running stats land in aux_params, weights in arg_params
+    assert set(aux) == {"bnm", "bnv"}
+    assert "c1w" in arg and "fcw" in arg
+    x = np.random.RandomState(2).normal(0, 1,
+                                        (2, 1, 12, 12)).astype(np.float32)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_elementwise_graph(tmp_path):
+    """Shape/elementwise op coverage: Add/Mul/Sqrt/Clip/Transpose/Reshape/
+    Concat/ReduceMean/Slice/Unsqueeze against a numpy oracle."""
+    rng = np.random.RandomState(3)
+    c = rng.uniform(0.5, 1.5, (4,)).astype(np.float32)
+    nodes = [
+        _node("Add", ["x", "c"], ["a"]),
+        _node("Mul", ["a", "a"], ["sq"]),
+        _node("Sqrt", ["sq"], ["s"]),
+        _node("Clip", ["s"], ["cl"], min=0.6, max=2.0),
+        _node("Transpose", ["cl"], ["tr"], perm=[1, 0]),
+        _node("Reshape", ["tr"], ["rs"], shape=[2, 6]),
+        _node("Concat", ["rs", "rs"], ["cc"], axis=0),
+        _node("ReduceMean", ["cc"], ["rm"], axes=[1], keepdims=1),
+        _node("Slice", ["rm"], ["out"], starts=[0], ends=[2], axes=[0]),
+    ]
+    m = _model(nodes, [_t("c", c)], [op.ValueInfo("x", (3, 4))],
+               [op.ValueInfo("out", (2, 1))])
+    path = str(tmp_path / "ew.onnx")
+    op.save_model(m, path)
+    sym, arg, aux = import_model(path)
+    x = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+
+    a = x + c
+    s = np.sqrt(a * a)
+    cl = np.clip(s, 0.6, 2.0)
+    rs = cl.T.reshape(2, 6)
+    cc = np.concatenate([rs, rs], axis=0)
+    rm = cc.mean(axis=1, keepdims=True)
+    want = rm[0:2]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_unsupported_op_reports_cleanly(tmp_path):
+    m = _model([_node("NonMaxSuppression", ["x"], ["y"])], [],
+               [op.ValueInfo("x", (2, 3))], [op.ValueInfo("y", (2, 3))])
+    path = str(tmp_path / "bad.onnx")
+    op.save_model(m, path)
+    with pytest.raises(mx.MXNetError, match="NonMaxSuppression"):
+        import_model(path)

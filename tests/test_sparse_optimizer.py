@@ -1,0 +1,89 @@
+"""row_sparse lazy_update optimizer semantics.
+
+Reference: src/operator/optimizer_op.cc sparse sgd/adam kernels and
+python/mxnet/optimizer.py:498 — with a row_sparse gradient and
+lazy_update=True, ONLY rows listed in grad.indices are updated; untouched
+rows skip weight decay, momentum decay and Adam moment updates entirely.
+With lazy_update=False the dense ("std") update applies everywhere.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _row_sparse_grad(shape, rows, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = np.zeros(shape, np.float32)
+    dense[rows] = rng.normal(0, 1, (len(rows),) + shape[1:])
+    return sp.row_sparse_array(dense)
+
+
+def test_sgd_lazy_update_touches_only_grad_rows():
+    shape, rows = (6, 3), [1, 4]
+    w0 = np.ones(shape, np.float32)
+    mom0 = np.full(shape, 0.5, np.float32)
+    grad = _row_sparse_grad(shape, rows)
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.1,
+                           lazy_update=True)
+    w = mx.nd.array(w0)
+    state = mx.nd.array(mom0)
+    opt.update(0, w, grad, state)
+    wn, mn = w.asnumpy(), state.asnumpy()
+
+    untouched = [0, 2, 3, 5]
+    # untouched rows: bitwise-unchanged weight AND momentum (no wd, no decay)
+    assert np.array_equal(wn[untouched], w0[untouched])
+    assert np.array_equal(mn[untouched], mom0[untouched])
+    # touched rows follow the dense formula
+    g = grad.asnumpy()[rows] + 0.1 * w0[rows]
+    expect_m = 0.9 * mom0[rows] - 0.1 * g
+    np.testing.assert_allclose(mn[rows], expect_m, rtol=1e-6)
+    np.testing.assert_allclose(wn[rows], w0[rows] + expect_m, rtol=1e-6)
+
+
+def test_sgd_std_update_touches_all_rows():
+    shape, rows = (6, 3), [1, 4]
+    w0 = np.ones(shape, np.float32)
+    grad = _row_sparse_grad(shape, rows)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.1,
+                           lazy_update=False)
+    w = mx.nd.array(w0)
+    state = mx.nd.array(np.full(shape, 0.5, np.float32))
+    opt.update(0, w, grad, state)
+    wn, mn = w.asnumpy(), state.asnumpy()
+    # std update: untouched rows still decay (wd) and momentum still decays
+    untouched = [0, 2, 3, 5]
+    expect_m_u = 0.9 * 0.5 - 0.1 * (0.1 * 1.0)
+    np.testing.assert_allclose(mn[untouched], expect_m_u, rtol=1e-6)
+    np.testing.assert_allclose(wn[untouched], 1.0 + expect_m_u, rtol=1e-6)
+
+
+def test_adam_lazy_update_touches_only_grad_rows():
+    shape, rows = (5, 2), [0, 3]
+    w0 = np.ones(shape, np.float32)
+    grad = _row_sparse_grad(shape, rows, seed=3)
+    opt = mx.optimizer.Adam(learning_rate=0.01, lazy_update=True)
+    w = mx.nd.array(w0)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    mean, var = state[0].asnumpy(), state[1].asnumpy()
+    untouched = [1, 2, 4]
+    assert np.array_equal(wn[untouched], w0[untouched])
+    assert np.all(mean[untouched] == 0) and np.all(var[untouched] == 0)
+    assert np.all(wn[rows] != w0[rows])
+    assert np.all(mean[rows] != 0)
+
+
+def test_dense_grad_ignores_lazy_flag():
+    """lazy_update=True with a DENSE grad must behave dense (reference:
+    lazy engages only when grad.stype == 'row_sparse')."""
+    shape = (4, 2)
+    w0 = np.ones(shape, np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, lazy_update=True)
+    w = mx.nd.array(w0)
+    grad = mx.nd.zeros(shape)   # dense all-zero grad: wd still applies
+    opt.update(0, w, grad, None)
+    np.testing.assert_allclose(w.asnumpy(), w0 - 0.1 * 0.1 * w0, rtol=1e-6)

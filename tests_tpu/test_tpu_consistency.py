@@ -383,3 +383,77 @@ def test_profiler_chrome_trace_on_chip(tmp_path):
     assert len(events) > 5
     assert any("Forward" in (n or "") for n in names)
     assert "sgd_update" in names
+
+
+# ---------------------------------------------------------------------------
+# Marked accelerator-coverage holes (VERDICT r2 #10): these subsystems are
+# verified on CPU only because the axon dev tunnel rejects PJRT host
+# callbacks. They are SKIPPED here — not silently absent — so the hole
+# stays visible; on a standard TPU runtime (which supports host send/recv
+# callbacks) remove the skips and these must pass as written.
+# ---------------------------------------------------------------------------
+
+_CALLBACK_SKIP = ("jax.pure_callback is unsupported by the axon tunnel "
+                  "('does not support host send/recv callbacks'); "
+                  "CustomOp/autograd.Function run verified on CPU only "
+                  "(tests/test_custom_op.py, tests/test_autograd.py). "
+                  "Re-enable on a standard TPU runtime.")
+
+
+@pytest.mark.skip(reason=_CALLBACK_SKIP)
+def test_custom_op_on_chip():
+    """mx.operator.CustomOp forward/backward on the TPU (custom-inl.h
+    escape-hatch role, SURVEY §2.2)."""
+    import mxnet_tpu.operator as op
+
+    class Square(op.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        2 * in_data[0] * out_grad[0])
+
+    @op.register("square_tpu")
+    class SquareProp(op.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Square()
+
+    x = mx.nd.array(np.arange(6).reshape(2, 3), ctx=mx.tpu(0))
+    x.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="square_tpu")
+    y.backward(mx.nd.ones_like(y))
+    assert_almost_equal(y.asnumpy(), (np.arange(6).reshape(2, 3)) ** 2)
+
+
+@pytest.mark.skip(reason=_CALLBACK_SKIP)
+def test_autograd_function_on_chip():
+    """mx.autograd.Function custom-vjp path on the TPU (reference
+    autograd.py:383)."""
+    from mxnet_tpu import autograd
+
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.5, -1.0, 2.0], ctx=mx.tpu(0))
+    x.attach_grad()
+    with autograd.record():
+        y = Sigmoid()(x)
+    y.backward(mx.nd.ones_like(y))
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad.asnumpy(), sig * (1 - sig), atol=1e-5)

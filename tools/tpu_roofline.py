@@ -81,10 +81,15 @@ def analyze(trace_dir, steps, batch):
         a = e.get("args") or {}
         if "hlo_category" not in a:
             continue
-        r = agg[a["hlo_category"]]
+        cat = a["hlo_category"]
+        r = agg[cat]
         r[0] += int(a.get("device_duration_ps", 0))
         r[1] += int(a.get("model_flops", 0) or 0)
-        r[2] += int(a.get("raw_bytes_accessed", 0) or 0)
+        # -start events report the same raw_bytes_accessed as their -done
+        # counterpart (one DMA, two trace events) — count bytes only on
+        # completion so totals aren't double-counted
+        if not cat.endswith("-start") and cat != "async-start":
+            r[2] += int(a.get("raw_bytes_accessed", 0) or 0)
         r[3] += 1
 
     tot_ps = sum(v[0] for v in agg.values())
