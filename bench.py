@@ -51,6 +51,88 @@ def _resnet50_symbol():
     return mx.sym.SoftmaxOutput(net(data), name="softmax")
 
 
+def _resnet152_symbol():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet152_v1()
+    data = mx.sym.Variable("data")
+    return mx.sym.SoftmaxOutput(net(data), name="softmax")
+
+
+def _train_ips_quick(sym, mesh, dtype, batch, steps=10):
+    """One-window throughput for secondary lanes (resnet-152, lstm)."""
+    from mxnet_tpu.parallel import DataParallelTrainer
+    trainer = DataParallelTrainer(sym, mesh, optimizer="sgd",
+                                  learning_rate=0.05, momentum=0.9,
+                                  rescale_grad=1.0 / batch, dtype=dtype)
+    params, states, aux = trainer.init_state(
+        {"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
+    rng = np.random.RandomState(0)
+    x = rng.uniform(0, 1, size=(batch, 3, 224, 224)).astype(np.float32)
+    y = rng.randint(0, 1000, size=(batch,)).astype(np.float32)
+    inputs = trainer.shard_inputs([x, y])
+    for _ in range(2):
+        params, states, aux, loss, _ = trainer.step(params, states, aux,
+                                                    inputs)
+    float(loss)
+    rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, states, aux, loss, _ = trainer.step(params, states,
+                                                        aux, inputs)
+        float(loss)
+        rates.append(steps * batch / (time.perf_counter() - t0))
+    return max(rates)
+
+
+def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
+                         layers=2):
+    """LSTM LM training throughput (BASELINE config 4 role: bucketing
+    LSTM): fused RNN symbol, full fwd+bwd+update step, tokens/sec."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import DataParallelTrainer
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden,
+                           name="emb")
+    emb_t = mx.sym.transpose(emb, axes=(1, 0, 2))  # TNC for fused RNN
+    rnn = mx.sym.RNN(emb_t, mx.sym.Variable("rnn_params"),
+                     mx.sym.Variable("state"), mx.sym.Variable("state_cell"),
+                     state_size=hidden, num_layers=layers, mode="lstm",
+                     name="lstm")
+    out = mx.sym.transpose(rnn, axes=(1, 0, 2))
+    logits = mx.sym.FullyConnected(mx.sym.reshape(out, shape=(-1, hidden)),
+                                   num_hidden=vocab, name="dec")
+    sym = mx.sym.SoftmaxOutput(logits, name="softmax", multi_output=False)
+
+    trainer = DataParallelTrainer(
+        sym, mesh, data_names=("data", "state", "state_cell"),
+        label_names=("softmax_label",), optimizer="sgd", learning_rate=0.1,
+        rescale_grad=1.0 / (batch * seq), dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    shapes = {"data": (batch, seq), "state": (layers, batch, hidden),
+              "state_cell": (layers, batch, hidden),
+              "softmax_label": (batch * seq,)}
+    params, states, aux = trainer.init_state(shapes)
+    x = rng.randint(0, vocab, (batch, seq)).astype(np.float32)
+    h0 = np.zeros((layers, batch, hidden), np.float32)
+    y = rng.randint(0, vocab, (batch * seq,)).astype(np.float32)
+    inputs = trainer.shard_inputs([x, h0, h0.copy(), y])
+    for _ in range(2):
+        params, states, aux, loss, _ = trainer.step(params, states, aux,
+                                                    inputs)
+    float(loss)
+    rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, states, aux, loss, _ = trainer.step(params, states,
+                                                        aux, inputs)
+        float(loss)
+        rates.append(10 * batch * seq / (time.perf_counter() - t0))
+    return max(rates)
+
+
 def _cost_flops(jitted, *args):
     """Model FLOPs of a compiled executable, from XLA's cost analysis.
     Returns None if the backend doesn't support it."""
@@ -203,8 +285,19 @@ def main():
                        else RN50_FWD_FLOPS_PER_IMG)
     infer_mfu = infer16_ips * infer_flops_img / V5E_PEAK_FLOPS
 
-    # accuracy lane last but guarded: a missing sklearn or a lane failure
-    # must not discard the timing results measured above
+    # secondary lanes, each guarded: failures must not discard the
+    # flagship numbers measured above
+    try:
+        # apples-to-apples with the published K80 ResNet-152 row
+        # (README.md:311, batch/GPU 32 — we use 64 for lane fill)
+        rn152_ips = round(_train_ips_quick(_resnet152_symbol(), mesh,
+                                           "bfloat16", batch=64), 2)
+    except Exception as e:
+        rn152_ips = f"unavailable: {type(e).__name__}"
+    try:
+        lstm_tps = round(_lstm_tokens_per_sec(mesh), 0)
+    except Exception as e:
+        lstm_tps = f"unavailable: {type(e).__name__}"
     try:
         acc_lane = round(_accuracy_lane(), 4)
     except Exception as e:
@@ -229,7 +322,10 @@ def main():
         "inference_vs_baseline": round(infer_ips / K80_RN50_INFER_B32, 2),
         "inference_bf16_vs_baseline": round(
             infer16_ips / K80_RN50_INFER_B32, 2),
-        "vs_k80_resnet152_train": round(train_ips / K80_RN152_TRAIN, 2),
+        "resnet152_train_ips_b64": rn152_ips,
+        "resnet152_vs_k80": round(rn152_ips / K80_RN152_TRAIN, 2)
+        if isinstance(rn152_ips, float) else None,
+        "lstm_lm_train_tokens_per_sec": lstm_tps,
         "accuracy_lane_lenet_digits_val_acc": acc_lane,
         "timing": "median-of-3x20-steps",
     }))

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Import an ONNX model and run it on the TPU.
+
+Role of the reference's ONNX tutorial flow (contrib/onnx _import):
+
+  python examples/onnx_import.py [model.onnx] [--ctx tpu]
+
+Without an argument, assembles a small convnet ONNX file first (this
+zero-egress image has no models to download) using the bundled wire
+codec, so the example is self-contained end to end.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import import_model, get_model_metadata
+from mxnet_tpu.contrib.onnx import onnx_proto as op
+
+
+def make_demo_model(path):
+    rng = np.random.RandomState(0)
+
+    def t(name, a):
+        return op.Tensor(name, np.ascontiguousarray(a.astype(np.float32)))
+
+    def n(op_type, ins, outs, **attrs):
+        return op.Node(op_type, ins, outs,
+                       attrs={k: op.Attribute.make(k, v)
+                              for k, v in attrs.items()})
+
+    model = op.Model(op.Graph(
+        nodes=[
+            n("Conv", ["x", "c1w", "c1b"], ["c1"], kernel_shape=[3, 3],
+              pads=[1, 1, 1, 1]),
+            n("Relu", ["c1"], ["r1"]),
+            n("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+              strides=[2, 2]),
+            n("Flatten", ["p1"], ["f"]),
+            n("Gemm", ["f", "fw", "fb"], ["logits"], transB=1),
+            n("Softmax", ["logits"], ["prob"], axis=-1),
+        ],
+        initializers=[
+            t("c1w", rng.normal(0, 0.2, (8, 1, 3, 3))),
+            t("c1b", np.zeros(8)),
+            t("fw", rng.normal(0, 0.1, (10, 8 * 14 * 14))),
+            t("fb", np.zeros(10)),
+        ],
+        inputs=[op.ValueInfo("x", (1, 1, 28, 28))],
+        outputs=[op.ValueInfo("prob", (1, 10))]))
+    op.save_model(model, path)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?", default=None)
+    ap.add_argument("--ctx", default="tpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+
+    path = args.model
+    if path is None:
+        path = "/tmp/onnx_demo.onnx"
+        make_demo_model(path)
+        print(f"assembled demo model at {path}")
+
+    meta = get_model_metadata(path)
+    print("inputs: ", meta["input_tensor_data"])
+    print("outputs:", meta["output_tensor_data"])
+
+    sym, arg_params, aux_params = import_model(path)
+    ctx = mx.tpu(0) if args.ctx == "tpu" else mx.cpu(0)
+    name, shape = meta["input_tensor_data"][0]
+    exe = sym.simple_bind(ctx, grad_req="null", **{name: shape},
+                          **{k: v.shape for k, v in arg_params.items()})
+    for k, v in arg_params.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in aux_params.items():
+        exe.aux_dict[k][:] = v.asnumpy()
+    exe.arg_dict[name][:] = np.random.RandomState(1).normal(
+        0, 1, shape).astype(np.float32)
+    out = exe.forward(is_train=False)[0]
+    print(f"ran on {ctx}: output shape {out.shape}, "
+          f"argmax {int(out.asnumpy().argmax())}")
+
+
+if __name__ == "__main__":
+    main()

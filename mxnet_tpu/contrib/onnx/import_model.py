@@ -28,10 +28,16 @@ def import_model(model_file):
 
 
 def get_model_metadata(model_file):
-    """Input/output tensor metadata of an ONNX file without binding it:
+    """Input/output tensor metadata of an ONNX file without translating or
+    binding it (works even when the graph uses untranslated operators):
     {'input_tensor_data': [(name, shape)...],
      'output_tensor_data': [(name, shape)...]}."""
     model = onnx_proto.load_model(model_file)
-    g = GraphProto()
-    g.from_onnx(model.graph, opset=model.opset)
-    return g.model_metadata
+    inits = {t.name for t in model.graph.initializers}
+    return {
+        "input_tensor_data": [(vi.name, tuple(vi.shape))
+                              for vi in model.graph.inputs
+                              if vi.name not in inits],
+        "output_tensor_data": [(vi.name, tuple(vi.shape))
+                               for vi in model.graph.outputs],
+    }

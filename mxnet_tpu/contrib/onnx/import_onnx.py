@@ -33,14 +33,27 @@ def _pads_to_mx(pads, nspatial):
     return tuple(int(p) for p in begin)
 
 
-def _asym_pad(data, pads, nspatial):
-    """Explicit mx.sym.pad for asymmetric ONNX conv/pool pads (NCHW)."""
+def _asym_pad(data, pads, nspatial, value=0.0):
+    """Explicit mx.sym.pad for asymmetric ONNX conv/pool pads (NCHW).
+    `value` must match the pooling identity for pools (-inf for max)."""
     begin, end = pads[:nspatial], pads[nspatial:]
     width = [0, 0, 0, 0]
     for b, e in zip(begin, end):
         width += [int(b), int(e)]
-    return mx.sym.pad(data, mode="constant", constant_value=0.0,
+    return mx.sym.pad(data, mode="constant", constant_value=value,
                       pad_width=tuple(width))
+
+
+def _check_auto_pad(node, attrs):
+    """SAME_UPPER/SAME_LOWER need input spatial dims the importer does not
+    track for intermediates — refuse loudly instead of mistranslating to
+    pad 0 (code-review finding). NOTSET/VALID mean explicit/zero pads."""
+    ap = attrs.get("auto_pad", b"NOTSET")
+    ap = ap.decode() if isinstance(ap, bytes) else str(ap)
+    if ap not in ("NOTSET", "VALID", ""):
+        raise MXNetError(
+            f"ONNX import: {node.op_type} auto_pad={ap!r} is unsupported "
+            "— re-export the model with explicit 'pads'")
 
 
 class GraphProto:
@@ -136,6 +149,7 @@ def _reg(*names):
 
 @_reg("Conv")
 def _conv(g, node, attrs):
+    _check_auto_pad(node, attrs)
     data = g._in(node, 0)
     weight = g._in(node, 1)
     bias = g._in(node, 2) if len(node.inputs) > 2 else None
@@ -153,8 +167,12 @@ def _conv(g, node, attrs):
                                                      (1,) * ns)),
               num_group=int(attrs.get("group", 1)))
     wname = node.inputs[1]
-    num_filter = int(g._params[wname].shape[0]) if wname in g._params \
-        else int(attrs["kernel_shape"][0])
+    if wname not in g._params:
+        raise MXNetError(
+            f"ONNX import: Conv weight {wname!r} is not an initializer — "
+            "num_filter cannot be determined (weight-as-input graphs are "
+            "unsupported)")
+    num_filter = int(g._params[wname].shape[0])
     if bias is None:
         out = mx.sym.Convolution(data, weight, num_filter=num_filter,
                                  no_bias=True, **kw)
@@ -162,6 +180,96 @@ def _conv(g, node, attrs):
         out = mx.sym.Convolution(data, weight, bias, num_filter=num_filter,
                                  no_bias=False, **kw)
     g._set(node, out)
+
+
+@_reg("ConvTranspose")
+def _conv_transpose(g, node, attrs):
+    data = g._in(node, 0)
+    weight = g._in(node, 1)
+    bias = g._in(node, 2) if len(node.inputs) > 2 else None
+    kshape = tuple(int(k) for k in attrs["kernel_shape"])
+    ns = len(kshape)
+    pads = [int(p) for p in attrs.get("pads", ())]
+    pad = _pads_to_mx(pads, ns)
+    if pad is None:
+        raise MXNetError("ONNX import: asymmetric ConvTranspose pads "
+                         "unsupported")
+    wname = node.inputs[1]
+    if wname not in g._params:
+        raise MXNetError(
+            f"ONNX import: ConvTranspose weight {wname!r} is not an "
+            "initializer — num_filter cannot be determined")
+    # onnx W: (Cin, Cout/group, *k) — the Deconvolution layout exactly
+    num_filter = int(g._params[wname].shape[1]) \
+        * int(attrs.get("group", 1))
+    kw = dict(kernel=kshape, pad=pad,
+              stride=tuple(int(s) for s in attrs.get("strides",
+                                                     (1,) * ns)),
+              dilate=tuple(int(d) for d in attrs.get("dilations",
+                                                     (1,) * ns)),
+              adj=tuple(int(a) for a in attrs.get("output_padding",
+                                                  (0,) * ns)),
+              num_group=int(attrs.get("group", 1)),
+              num_filter=num_filter)
+    if bias is None:
+        out = mx.sym.Deconvolution(data, weight, no_bias=True, **kw)
+    else:
+        out = mx.sym.Deconvolution(data, weight, bias, no_bias=False, **kw)
+    g._set(node, out)
+
+
+@_reg("Split")
+def _split(g, node, attrs):
+    axis = int(attrs.get("axis", 0))
+    data = g._in(node, 0)
+    splits = attrs.get("split")
+    if splits is None and len(node.inputs) > 1:
+        splits = g._const(node, 1, "split")
+    if splits is None:
+        out = mx.sym.SliceChannel(data, num_outputs=len(node.outputs),
+                                  axis=axis)
+        for i in range(len(node.outputs)):
+            g._set(node, out[i], i)
+        return
+    begin = 0
+    for i, s in enumerate(splits):
+        g._set(node, mx.sym.slice_axis(data, axis=axis, begin=begin,
+                                       end=begin + int(s)), i)
+        begin += int(s)
+
+
+@_reg("RandomNormal")
+def _random_normal(g, node, attrs):
+    g._set(node, mx.sym.random_normal(
+        loc=float(attrs.get("mean", 0.0)),
+        scale=float(attrs.get("scale", 1.0)),
+        shape=tuple(int(s) for s in attrs["shape"])))
+
+
+@_reg("RandomUniform")
+def _random_uniform(g, node, attrs):
+    g._set(node, mx.sym.random_uniform(
+        low=float(attrs.get("low", 0.0)),
+        high=float(attrs.get("high", 1.0)),
+        shape=tuple(int(s) for s in attrs["shape"])))
+
+
+@_reg("RandomNormalLike")
+def _random_normal_like(g, node, attrs):
+    # one draw per element of the input: sample_normal over broadcast
+    # mu/sigma arrays shaped like x (no static shape needed at import)
+    x = g._in(node, 0)
+    mu = mx.sym.ones_like(x) * float(attrs.get("mean", 0.0))
+    sigma = mx.sym.ones_like(x) * float(attrs.get("scale", 1.0))
+    g._set(node, mx.sym._sample_normal(mu, sigma))
+
+
+@_reg("RandomUniformLike")
+def _random_uniform_like(g, node, attrs):
+    x = g._in(node, 0)
+    low = mx.sym.ones_like(x) * float(attrs.get("low", 0.0))
+    high = mx.sym.ones_like(x) * float(attrs.get("high", 1.0))
+    g._set(node, mx.sym._sample_uniform(low, high))
 
 
 @_reg("Gemm")
@@ -212,14 +320,24 @@ def _pool(g, node, attrs, ptype, global_pool):
         g._set(node, mx.sym.Pooling(data, global_pool=True, kernel=(1, 1),
                                     pool_type=ptype))
         return
+    _check_auto_pad(node, attrs)
     kshape = tuple(int(k) for k in attrs["kernel_shape"])
     ns = len(kshape)
     pads = [int(p) for p in attrs.get("pads", ())]
     pad = _pads_to_mx(pads, ns)
-    if pad is None:
-        data = _asym_pad(data, pads, ns)
-        pad = (0,) * ns
     count_include_pad = bool(int(attrs.get("count_include_pad", 0)))
+    if pad is None:
+        # pre-pad with the pooling identity: -inf for max (a 0 would win
+        # over negative activations at the borders — review finding); avg
+        # with explicit pre-pad necessarily counts the padding
+        if ptype == "avg" and not count_include_pad:
+            raise MXNetError(
+                "ONNX import: AveragePool with asymmetric pads and "
+                "count_include_pad=0 is unsupported")
+        data = _asym_pad(data, pads, ns,
+                         value=-3.4e38 if ptype == "max" else 0.0)
+        pad = (0,) * ns
+        count_include_pad = True
     g._set(node, mx.sym.Pooling(
         data, kernel=kshape, pool_type=ptype, pad=pad,
         stride=tuple(int(s) for s in attrs.get("strides", (1,) * ns)),
@@ -350,12 +468,20 @@ def _reshape(g, node, attrs):
 
 @_reg("Flatten")
 def _flatten(g, node, attrs):
+    # ONNX Flatten is ALWAYS 2-D: (prod(dims[:axis]), prod(dims[axis:]))
     axis = int(attrs.get("axis", 1))
+    out = g._in(node, 0)
+    if axis == 0:
+        g._set(node, mx.sym.reshape(out, shape=(1, -1)))
+        return
     if axis == 1:
-        g._set(node, mx.sym.Flatten(g._in(node, 0)))
-    else:
-        g._set(node, mx.sym.reshape(g._in(node, 0), shape=(0,) * axis
-                                    + (-1,)))
+        g._set(node, mx.sym.Flatten(out))
+        return
+    # fold the leading `axis` dims one pair at a time (-3 merges the first
+    # two dims, -2 copies the rest), then flatten the tail
+    for _ in range(axis - 1):
+        out = mx.sym.reshape(out, shape=(-3, -2))
+    g._set(node, mx.sym.reshape(out, shape=(0, -1)))
 
 
 @_reg("Transpose")
@@ -423,8 +549,10 @@ def _slice(g, node, attrs):
 
 @_reg("Gather")
 def _gather(g, node, attrs):
+    # mode='wrap': ONNX permits negative (from-the-end) indices, which
+    # the default 'clip' mode would silently pin to 0
     g._set(node, mx.sym.take(g._in(node, 0), g._in(node, 1),
-                             axis=int(attrs.get("axis", 0))))
+                             axis=int(attrs.get("axis", 0)), mode="wrap"))
 
 
 @_reg("Cast")

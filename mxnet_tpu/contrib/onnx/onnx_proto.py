@@ -162,7 +162,12 @@ class Tensor:
         elif int64s:
             arr = np.asarray(int64s, np.int64)
         elif int32s:
-            arr = np.asarray(int32s, dtype)
+            if dtype == np.float16:
+                # per onnx.proto, FLOAT16 values travel as uint16 BIT
+                # PATTERNS in int32_data — reinterpret, don't cast
+                arr = np.asarray(int32s, np.uint16).view(np.float16)
+            else:
+                arr = np.asarray(int32s, dtype)
         else:
             arr = np.zeros(0, dtype)
         return cls(name, arr.astype(dtype).reshape([int(d) for d in dims]))

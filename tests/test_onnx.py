@@ -183,6 +183,110 @@ def test_import_elementwise_graph(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_import_convtranspose_split_matches_torch(tmp_path):
+    torch.manual_seed(2)
+    deconv = tnn.ConvTranspose2d(3, 4, 3, stride=2, padding=1).eval()
+    m = _model(
+        [_node("ConvTranspose", ["x", "w", "b"], ["d"], kernel_shape=[3, 3],
+               strides=[2, 2], pads=[1, 1, 1, 1]),
+         _node("Split", ["d"], ["s0", "s1"], axis=1, split=[1, 3]),
+         _node("Relu", ["s1"], ["out"])],
+        [_t("w", deconv.weight.detach().numpy()),
+         _t("b", deconv.bias.detach().numpy())],
+        [op.ValueInfo("x", (2, 3, 5, 5))],
+        [op.ValueInfo("s0", (2, 1, 9, 9)), op.ValueInfo("out", (2, 3, 9, 9))])
+    path = str(tmp_path / "ct.onnx")
+    op.save_model(m, path)
+    sym, arg, aux = import_model(path)
+    x = np.random.RandomState(5).normal(0, 1, (2, 3, 5, 5)).astype(np.float32)
+    outs = _forward(sym, arg, aux, {"x": x})
+    want = deconv(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(outs[0], want[:, :1], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs[1], np.maximum(want[:, 1:], 0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_import_random_like_ops(tmp_path):
+    m = _model(
+        [_node("RandomNormalLike", ["x"], ["rn"], mean=2.0, scale=0.5),
+         _node("RandomUniformLike", ["x"], ["ru"], low=1.0, high=3.0),
+         _node("Add", ["rn", "ru"], ["out"])],
+        [],
+        [op.ValueInfo("x", (400, 50))],
+        [op.ValueInfo("out", (400, 50))])
+    path = str(tmp_path / "rand.onnx")
+    op.save_model(m, path)
+    sym, arg, aux = import_model(path)
+    out = _forward(sym, arg, aux,
+                   {"x": np.zeros((400, 50), np.float32)})[0]
+    assert out.shape == (400, 50)
+    # normal(2, 0.5) + uniform(1, 3): mean 4, var 0.25 + 4/12
+    assert abs(out.mean() - 4.0) < 0.05
+    assert abs(out.var() - (0.25 + 4.0 / 12)) < 0.05
+
+
+def test_review_regressions(tmp_path):
+    """Code-review fixes: Flatten axis semantics, negative Gather indices,
+    -inf pre-pad for asymmetric MaxPool, auto_pad refusal, fp16
+    bit-pattern decoding."""
+    # Flatten axis=2 must be 2-D (prod leading, prod trailing)
+    m = _model([_node("Flatten", ["x"], ["y"], axis=2)], [],
+               [op.ValueInfo("x", (2, 3, 4, 5))],
+               [op.ValueInfo("y", (6, 20))])
+    p = str(tmp_path / "fl.onnx")
+    op.save_model(m, p)
+    sym, arg, aux = import_model(p)
+    x = np.arange(120, dtype=np.float32).reshape(2, 3, 4, 5)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+    np.testing.assert_array_equal(got, x.reshape(6, 20))
+
+    # Gather with negative index selects from the end
+    idx = np.array([-1.0, 0.0], np.float32)
+    m = _model([_node("Gather", ["x", "i"], ["y"], axis=0)],
+               [_t("i", idx)],
+               [op.ValueInfo("x", (5, 2))], [op.ValueInfo("y", (2, 2))])
+    p = str(tmp_path / "ga.onnx")
+    op.save_model(m, p)
+    sym, arg, aux = import_model(p)
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+    np.testing.assert_array_equal(got, x[[-1, 0]])
+
+    # asymmetric MaxPool over all-negative data must not leak pad zeros
+    m = _model([_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                      strides=[2, 2], pads=[0, 0, 1, 1])], [],
+               [op.ValueInfo("x", (1, 1, 3, 3))],
+               [op.ValueInfo("y", (1, 1, 2, 2))])
+    p = str(tmp_path / "mp.onnx")
+    op.save_model(m, p)
+    sym, arg, aux = import_model(p)
+    x = -np.ones((1, 1, 3, 3), np.float32)
+    got = _forward(sym, arg, aux, {"x": x})[0]
+    assert (got == -1.0).all(), got
+
+    # auto_pad SAME_UPPER refuses instead of mistranslating
+    m = _model([_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                      auto_pad="SAME_UPPER")],
+               [_t("w", np.zeros((4, 1, 3, 3), np.float32))],
+               [op.ValueInfo("x", (1, 1, 8, 8))],
+               [op.ValueInfo("y", (1, 4, 8, 8))])
+    p = str(tmp_path / "ap.onnx")
+    op.save_model(m, p)
+    with pytest.raises(mx.MXNetError, match="auto_pad"):
+        import_model(p)
+
+    # fp16 values in int32_data are uint16 BIT PATTERNS (15360 == 1.0):
+    # hand-encode via field 5 instead of raw_data
+    bits = np.array([1.0, -2.5], np.float16).view(np.uint16)
+    payload = b"".join(op._varint_field(1, d) for d in (2,))
+    payload += op._varint_field(2, 10)  # data_type FLOAT16
+    packed = b"".join(op._svarint(int(b)) for b in bits)
+    payload += op._tag(5, 2) + op._svarint(len(packed)) + packed
+    parsed = op.Tensor.parse(payload)
+    np.testing.assert_array_equal(parsed.array,
+                                  np.array([1.0, -2.5], np.float16))
+
+
 def test_unsupported_op_reports_cleanly(tmp_path):
     m = _model([_node("NonMaxSuppression", ["x"], ["y"])], [],
                [op.ValueInfo("x", (2, 3))], [op.ValueInfo("y", (2, 3))])
