@@ -96,8 +96,14 @@ def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
     emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden,
                            name="emb")
     emb_t = mx.sym.transpose(emb, axes=(1, 0, 2))  # TNC for fused RNN
+    # initial states enter BATCH-major (batch, layers, hidden) so the
+    # data-parallel axis-0 sharding of shard_inputs splits the batch, not
+    # the layers axis; transposed to the RNN op's (layers, batch, hidden)
+    state_bf = mx.sym.Variable("state")
+    cell_bf = mx.sym.Variable("state_cell")
     rnn = mx.sym.RNN(emb_t, mx.sym.Variable("rnn_params"),
-                     mx.sym.Variable("state"), mx.sym.Variable("state_cell"),
+                     mx.sym.transpose(state_bf, axes=(1, 0, 2)),
+                     mx.sym.transpose(cell_bf, axes=(1, 0, 2)),
                      state_size=hidden, num_layers=layers, mode="lstm",
                      name="lstm")
     out = mx.sym.transpose(rnn, axes=(1, 0, 2))
@@ -110,12 +116,12 @@ def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
         label_names=("softmax_label",), optimizer="sgd", learning_rate=0.1,
         rescale_grad=1.0 / (batch * seq), dtype="bfloat16")
     rng = np.random.RandomState(0)
-    shapes = {"data": (batch, seq), "state": (layers, batch, hidden),
-              "state_cell": (layers, batch, hidden),
+    shapes = {"data": (batch, seq), "state": (batch, layers, hidden),
+              "state_cell": (batch, layers, hidden),
               "softmax_label": (batch * seq,)}
     params, states, aux = trainer.init_state(shapes)
     x = rng.randint(0, vocab, (batch, seq)).astype(np.float32)
-    h0 = np.zeros((layers, batch, hidden), np.float32)
+    h0 = np.zeros((batch, layers, hidden), np.float32)
     y = rng.randint(0, vocab, (batch * seq,)).astype(np.float32)
     inputs = trainer.shard_inputs([x, h0, h0.copy(), y])
     for _ in range(2):
@@ -200,6 +206,42 @@ def _infer_ips(run, argv, aux, key, want_flops=False):
         np.asarray(out)
         inf_rates.append(n_inf * INFER_BATCH / (time.perf_counter() - t0))
     return sorted(inf_rates)[1], flops
+
+
+def _flash_attention_tokens_per_sec(batch=8, heads=8, seq=4096, dim=128):
+    """Long-context lane: Pallas flash-attention fwd+bwd throughput at a
+    sequence length where naive attention would materialize a 4096^2
+    score matrix per head. Tokens/sec over the full train-direction step."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, heads, seq, dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal=True)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    l, _ = step(q, k, v)
+    float(l)
+    rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(10):
+            out = step(q, k, v)
+        float(out[0])
+        rates.append(10 * batch * seq / (time.perf_counter() - t0))
+    return max(rates)
 
 
 def _accuracy_lane():
@@ -299,6 +341,10 @@ def main():
     except Exception as e:
         lstm_tps = f"unavailable: {type(e).__name__}"
     try:
+        fa_tps = round(_flash_attention_tokens_per_sec(), 0)
+    except Exception as e:
+        fa_tps = f"unavailable: {type(e).__name__}"
+    try:
         acc_lane = round(_accuracy_lane(), 4)
     except Exception as e:
         acc_lane = f"unavailable: {type(e).__name__}"
@@ -326,6 +372,7 @@ def main():
         "resnet152_vs_k80": round(rn152_ips / K80_RN152_TRAIN, 2)
         if isinstance(rn152_ips, float) else None,
         "lstm_lm_train_tokens_per_sec": lstm_tps,
+        "flash_attention_seq4096_tokens_per_sec": fa_tps,
         "accuracy_lane_lenet_digits_val_acc": acc_lane,
         "timing": "median-of-3x20-steps",
     }))

@@ -184,6 +184,12 @@ def _conv(g, node, attrs):
 
 @_reg("ConvTranspose")
 def _conv_transpose(g, node, attrs):
+    _check_auto_pad(node, attrs)
+    if "output_shape" in attrs:
+        # per spec output_shape overrides pads — refusing beats silently
+        # producing the wrong spatial dims
+        raise MXNetError("ONNX import: ConvTranspose output_shape attr "
+                         "unsupported — re-export with explicit pads")
     data = g._in(node, 0)
     weight = g._in(node, 1)
     bias = g._in(node, 2) if len(node.inputs) > 2 else None
@@ -470,6 +476,11 @@ def _reshape(g, node, attrs):
 def _flatten(g, node, attrs):
     # ONNX Flatten is ALWAYS 2-D: (prod(dims[:axis]), prod(dims[axis:]))
     axis = int(attrs.get("axis", 1))
+    if axis < 0:
+        # normalizing needs the input's static rank, which intermediates
+        # don't carry here — refuse instead of silently mis-grouping
+        raise MXNetError("ONNX import: negative Flatten axis unsupported "
+                         "— re-export with a non-negative axis")
     out = g._in(node, 0)
     if axis == 0:
         g._set(node, mx.sym.reshape(out, shape=(1, -1)))
