@@ -255,6 +255,14 @@ class Executor:
         self.outputs = []
         self._monitor_callback = None
 
+        # graphs without rng consumers reuse one device-resident key per
+        # executor: minting + uploading a key per forward() is a serial
+        # host->device round-trip (~1-2 ms through a remote PJRT tunnel),
+        # pure overhead for the (common) dropout-free eval path
+        self._has_rng = any(n.op is not None and n.op.needs_rng
+                            for n in symbol._topo())
+        self._rng_const = None
+
         self._jit_eval = None
         self._jit_fwd_train = None     # train-mode forward only (no diff args)
         self._fused_ones = None        # fwd+bwd, ones cotangents, one XLA module
@@ -399,9 +407,16 @@ class Executor:
                 target = dev
             self.arg_dict[k]._data = jax.device_put(new, target)
 
-        rng = _random.next_key()
-        rng = jax.device_put(
-            rng, self._repl_sharding if self._mesh is not None else dev)
+        if self._has_rng:
+            rng = jax.device_put(
+                _random.next_key(),
+                self._repl_sharding if self._mesh is not None else dev)
+        else:
+            if self._rng_const is None:
+                self._rng_const = jax.device_put(
+                    jax.random.PRNGKey(0),
+                    self._repl_sharding if self._mesh is not None else dev)
+            rng = self._rng_const  # unused by the traced program
         if self._monitor_callback is not None:
             if not is_train:
                 self._pending = self._pending_grads = None
