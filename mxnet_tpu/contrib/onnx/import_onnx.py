@@ -312,6 +312,13 @@ def _matmul(g, node, attrs):
 
 @_reg("BatchNormalization")
 def _bn(g, node, attrs):
+    if int(attrs.get("spatial", 1)) == 0:
+        # opset<9 per-element stats: reduction axes differ from spatial
+        # BN — refuse loudly rather than silently mistranslate (same
+        # pattern as _check_auto_pad)
+        raise MXNetError(
+            f"node {node.name!r}: BatchNormalization spatial=0 "
+            "(per-element statistics) is not supported")
     out = mx.sym.BatchNorm(
         g._in(node, 0), g._in(node, 1), g._in(node, 2), g._in(node, 3),
         g._in(node, 4), eps=float(attrs.get("epsilon", 1e-5)),

@@ -254,6 +254,7 @@ class Executor:
         self.aux_arrays = [aux_dict[n] for n in self._aux_names]
         self.outputs = []
         self._monitor_callback = None
+        self._monitor_all = False
 
         # graphs without rng consumers reuse one device-resident key per
         # executor: minting + uploading a key per forward() is a serial
@@ -562,6 +563,11 @@ class Executor:
                 continue
             parsed = node.op.parse_attrs(node.attrs)
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
+            if self._monitor_all:
+                in_names = node.op.list_inputs(parsed)
+                for i, v in enumerate(ins):
+                    nm = in_names[i] if i < len(in_names) else str(i)
+                    self._monitor_callback(f"{node.name}_{nm}", NDArray(v))
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
             grp_dev = _node_group_dev(node, group2dev)
             node_platform = grp_dev.platform if grp_dev is not None \
@@ -641,7 +647,17 @@ class Executor:
         return dict(zip(self._output_names, self.outputs))
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Tap every node output (graph_executor.cc:1451 role). While a
+        callback is installed the forward runs the UNFUSED graph eagerly
+        (_forward_monitored), so monitored intermediates match the
+        per-node semantics — BN outputs are pre-relu even though the
+        normal path folds relu into BN (same discipline as cuDNN fusion
+        being bypassed under debugging). Backward still runs the fused
+        program from stashed inputs, paying ~2x forward cost.
+        monitor_all additionally taps every node INPUT (named
+        ``{node}_{input_name}``), the reference's monitor_all=True."""
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -158,7 +158,17 @@ class CTCLoss(Loss):
             pred = pred.swapaxes(0, 1)  # CTC op wants TNC
         if self._batch_axis == 1:
             label = label.swapaxes(0, 1)  # and NT labels
-        loss = F.CTCLoss(pred, label)
+        args = [pred, label]
+        if pred_lengths is not None:
+            args.append(pred_lengths)
+        if label_lengths is not None:
+            args.append(label_lengths)
+        # reference gluon contract (gluon/loss.py:474): blank is the LAST
+        # alphabet entry; labels are 0-based real classes, pad marker -1
+        loss = F.CTCLoss(*args,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
         return self._finalize(F, loss, sample_weight, mean=False)
 
 

@@ -44,10 +44,12 @@ class Monitor:
     sort: sort records by entry name before rendering.
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         self.interval = interval
         self.stat_func = stat_func or _mean_abs
         self.sort = sort
+        self.monitor_all = monitor_all
         self._name_filter = re.compile(pattern)
         self._records = []
         self._window_open = False
@@ -66,7 +68,7 @@ class Monitor:
 
     def install(self, exe):
         """Attach to an executor (the monitor itself is the callback)."""
-        exe.set_monitor_callback(self)
+        exe.set_monitor_callback(self, self.monitor_all)
         self._executors.append(exe)
 
     def _drain(self):

@@ -576,7 +576,12 @@ def _bn_train_bwd(axis, eps, fix_gamma, relu, res, cts):
         * rstd.reshape(bshape).astype(data.dtype)
     if relu:
         # recompute the relu mask from xhat (cheaper than saving `out`:
-        # out > 0 <=> g*xhat + beta > 0, all in-registers here)
+        # out > 0 <=> g*xhat + beta > 0, all in-registers here).
+        # Accepted tradeoff: g*xhat + beta is a different bf16 evaluation
+        # order than the forward's data*scale + shift, so an element
+        # landing EXACTLY on the relu boundary can round to a different
+        # side and flip its mask bit — bounded by one ulp of gradient
+        # noise on measure-zero inputs, in exchange for not saving `out`
         g_b = (jnp.ones_like(gamma) if fix_gamma else gamma) \
             .reshape(bshape).astype(data.dtype)
         pre = xhat * g_b + beta.reshape(bshape).astype(data.dtype)

@@ -61,7 +61,15 @@ def _p(**extra):
 
 def _row_mask(grad):
     """Rows "touched" by a row_sparse gradient, dense-backed: any nonzero
-    in the row (matches RowSparseNDArray.indices). Broadcastable mask."""
+    in the row (matches RowSparseNDArray.indices). Broadcastable mask.
+
+    Documented divergence from the reference's index-based lazy kernels
+    (src/operator/optimizer_op.cc): a row explicitly listed in
+    grad.indices whose values happen to be EXACTLY zero (e.g. in-batch
+    updates canceling) is treated as untouched here, so it also skips
+    wd/momentum/moment decay for that step. The dense-backed NDArray has
+    no index list to consult; value-inferred occupancy is the honest
+    equivalent (see also ndarray/sparse.py stance note)."""
     axes = tuple(range(1, grad.ndim))
     touched = jnp.any(grad != 0, axis=axes) if axes else (grad != 0)
     return touched.reshape((-1,) + (1,) * (grad.ndim - 1))

@@ -141,6 +141,30 @@ def test_losses_values():
     np.testing.assert_allclose(hl, [0.5], rtol=1e-5)
 
 
+def test_ctc_loss_forwards_lengths():
+    # gluon CTCLoss must pass pred/label lengths through to the op:
+    # truncated-length results must match slicing the inputs by hand
+    rng = np.random.RandomState(3)
+    t_len, b, a = 6, 2, 4
+    acts = rng.normal(size=(b, t_len, a)).astype(np.float32)  # NTC
+    # gluon contract (reference gluon/loss.py:474): 0-based labels,
+    # blank = LAST alphabet entry — real classes live in [0, a-1)
+    labels = np.array([[0, 1, 2], [2, 1, 0]], np.float32)
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    got = ctc(mx.nd.array(acts), mx.nd.array(labels),
+              mx.nd.array([4.0, 5.0]), mx.nd.array([2.0, 3.0])).asnumpy()
+    # oracle: per-sample full-length call on hand-truncated inputs
+    for i, (dl, ll) in enumerate([(4, 2), (5, 3)]):
+        ref = mx.nd.contrib.ctc_loss(
+            mx.nd.array(acts[i:i + 1, :dl].transpose(1, 0, 2)),
+            mx.nd.array(labels[i:i + 1, :ll]),
+            blank_label="last").asnumpy()
+        np.testing.assert_allclose(got[i], ref[0], rtol=1e-4)
+    # and lengths must actually change the answer vs the untruncated call
+    full = ctc(mx.nd.array(acts), mx.nd.array(labels)).asnumpy()
+    assert abs(full[0] - got[0]) > 1e-3
+
+
 def test_sigmoid_bce_stable():
     pred = mx.nd.array([[100.0], [-100.0]])
     label = mx.nd.array([[1.0], [0.0]])

@@ -287,6 +287,22 @@ def test_review_regressions(tmp_path):
                                   np.array([1.0, -2.5], np.float16))
 
 
+def test_bn_spatial0_refused(tmp_path):
+    # opset<9 BatchNormalization spatial=0 (per-element stats) must refuse
+    # loudly, not silently translate as spatial BN
+    z = np.zeros(4, np.float32)
+    m = _model([_node("BatchNormalization",
+                      ["x", "bng", "bnb", "bnm", "bnv"], ["y"], spatial=0)],
+               [_t("bng", z + 1), _t("bnb", z), _t("bnm", z),
+                _t("bnv", z + 1)],
+               [op.ValueInfo("x", (2, 4, 3, 3))],
+               [op.ValueInfo("y", (2, 4, 3, 3))])
+    path = str(tmp_path / "bnsp.onnx")
+    op.save_model(m, path)
+    with pytest.raises(mx.MXNetError, match="spatial"):
+        import_model(path)
+
+
 def test_unsupported_op_reports_cleanly(tmp_path):
     m = _model([_node("NonMaxSuppression", ["x"], ["y"])], [],
                [op.ValueInfo("x", (2, 3))], [op.ValueInfo("y", (2, 3))])

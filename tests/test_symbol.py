@@ -190,6 +190,31 @@ def test_monitor_callback():
     ex.forward(is_train=False)
     assert any("fc1_output" == s for s in seen)
     assert any("softmax_output" == s for s in seen)
+    # default monitor_all=False: outputs only
+    assert not any("fc1_data" == s for s in seen)
+    # monitor_all=True additionally taps node inputs by input name
+    seen.clear()
+    ex.set_monitor_callback(lambda name, arr: seen.append(name), True)
+    ex.forward(is_train=False)
+    assert any("fc1_data" == s for s in seen), seen
+    assert any("fc1_weight" in s for s in seen), seen
+    assert any("fc1_output" == s for s in seen)
+
+
+def test_monitor_class_monitor_all():
+    # mx.mon.Monitor(interval, monitor_all=True) must reach the executor's
+    # input taps (reference monitor.py forwards the flag)
+    from mxnet_tpu.monitor import Monitor
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 10))
+    mon = Monitor(1, monitor_all=True)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False)
+    rows = mon.toc()
+    names = [n for (_, n, _) in rows]
+    assert any("fc1_data" == n for n in names), names
+    assert any("fc1_output" == n for n in names)
 
 
 def test_variable_compose():
