@@ -5,6 +5,17 @@ Parity target: python/mxnet/gluon/trainer.py (SURVEY.md §2.4, §3.2):
 `_update` (:261). Single-process: grads already live on the parameter's
 context; multi-device DP rides the sharded step (mxnet_tpu.parallel), with
 the kvstore facade kept for explicit push/pull training loops.
+
+Similarity constraint note: the constructor signature, method names,
+argument-validation messages and the step/allreduce/update decision flow
+are pinned by the reference Trainer's public contract — downstream code
+calls `trainer.step`, toggles `update_on_kvstore`, and relies on the
+exact assertion wording. The update machinery underneath diverges from
+the reference (which keeps one weight copy per device and reduces
+through the kvstore): mesh-replicated parameters here expose ONE device
+buffer through N ctx slots, so pushes/updates dedup on device-buffer
+identity (`_buffer_key`/`_unique`) — machinery the reference does not
+have or need.
 """
 from __future__ import annotations
 
@@ -124,23 +135,58 @@ class Trainer:
         self._allreduce_grads()
 
     @staticmethod
-    def _unique(arrays):
+    def _buffer_key(a):
+        """Identity of the underlying device buffer, not the python
+        wrapper: a re-wrapped NDArray around the same jax array (or an
+        aliasing single-device buffer) must dedup with the original, or
+        the kvstore would sum the same gradient twice. id(wrapper) held
+        that invariant only by convention."""
+        data = a._data
+        try:
+            # single-device arrays: the actual device pointer catches
+            # aliasing even across distinct jax.Array objects
+            return data.unsafe_buffer_pointer()
+        except Exception:
+            # replicated/sharded mesh arrays: python identity of the
+            # jax.Array (one replicated array per mesh param)
+            return id(data)
+
+    @classmethod
+    def _alias_groups(cls, arrays):
+        """Group wrappers by underlying buffer. group[0] is the
+        representative handed to kvstore/updater; the rest are aliases
+        that must be re-synced after the representative's _data is
+        rebound (functional substrate: writes rebind, never mutate)."""
+        groups = {}
+        for a in arrays:
+            groups.setdefault(cls._buffer_key(a), []).append(a)
+        return list(groups.values())
+
+    @classmethod
+    def _unique(cls, arrays):
         # mesh-replicated params expose N references to ONE array; the
         # kvstore must see it once or it would sum the same grad N times
-        out, seen = [], set()
-        for a in arrays:
-            if id(a) not in seen:
-                seen.add(id(a))
-                out.append(a)
-        return out
+        return [g[0] for g in cls._alias_groups(arrays)]
+
+    @staticmethod
+    def _resync(groups):
+        # propagate the representative's (possibly rebound) buffer to
+        # aliased wrappers so no ctx slot is left holding a stale array;
+        # _rebind (not raw _data assignment) keeps an autograd-marked
+        # alias's captured leaf value fresh
+        for g in groups:
+            for alias in g[1:]:
+                alias._rebind(g[0]._data)
 
     def _allreduce_grads(self):
         if self._kvstore and not self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
-                    grads = self._unique(param.list_grad())
-                    self._kvstore.push(i, grads, priority=-i)
-                    self._kvstore.pull(i, grads, priority=-i)
+                    groups = self._alias_groups(param.list_grad())
+                    reps = [g[0] for g in groups]
+                    self._kvstore.push(i, reps, priority=-i)
+                    self._kvstore.pull(i, reps, priority=-i)
+                    self._resync(groups)
 
     def _update(self, ignore_stale_grad=False):
         if self._kvstore and self._update_on_kvstore:
@@ -148,21 +194,30 @@ class Trainer:
                 if param.grad_req != "null":
                     self._kvstore.push(i, self._unique(param.list_grad()),
                                        priority=-i)
-                    self._kvstore.pull(i, self._unique(param.list_data()),
+                    data_groups = self._alias_groups(param.list_data())
+                    self._kvstore.pull(i, [g[0] for g in data_groups],
                                        priority=-i)
+                    self._resync(data_groups)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            seen = set()
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                # mesh-replicated params share ONE array across all ctx
-                # slots — apply the update exactly once
-                if id(arr) in seen:
-                    continue
-                seen.add(id(arr))
-                upd(i, grad, arr)
+            # mesh-replicated params share ONE array across all ctx
+            # slots — apply the update exactly once per device buffer,
+            # then re-sync aliased wrappers to the rebound result
+            groups = []   # [rep_arr, rep_grad, aliases...] per buffer
+            by_key = {}
+            for arr, grad in zip(param.list_data(), param.list_grad()):
+                k = self._buffer_key(arr)
+                if k in by_key:
+                    by_key[k].append(arr)
+                else:
+                    by_key[k] = entry = [arr, grad]
+                    groups.append(entry)
+            for upd, (rep, grad, *aliases) in zip(self._updaters, groups):
+                upd(i, grad, rep)
+                for alias in aliases:
+                    alias._rebind(rep._data)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

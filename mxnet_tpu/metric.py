@@ -5,6 +5,18 @@ with Accuracy/TopKAccuracy/F1/Perplexity/MAE/MSE/RMSE/CrossEntropy/NLL/
 PearsonCorrelation/Loss/CustomMetric/CompositeEvalMetric and the `np` helper.
 Metric updates sync outputs to host (the one intentional host round-trip per
 batch, matching the reference's update_metric behavior).
+
+Similarity constraint note: the EvalMetric base-class surface
+(update/reset/get/get_name_value, `sum_metric`/`num_inst` accumulator
+attributes, label/pred argument order, output_names/label_names
+filtering, the `name` strings the registry and log lines key on) is
+API-pinned — user metrics SUBCLASS EvalMetric and touch those attributes
+directly, and `get_name_value` feeds the "Train-<name>" log contract.
+Each concrete metric's update() is a one-to-few-line textbook formula
+(argmax-equality mean, |p-l| mean, 2PR/(P+R), exp(avg nll)...) computed
+here with numpy on host-synced arrays; our F1 uses a running
+confusion-count design rather than the reference's
+_BinaryClassificationMetrics helper class.
 """
 from __future__ import annotations
 

@@ -4,9 +4,25 @@ Parity target: python/mxnet/module/base_module.py (SURVEY.md §2.4, §3.1):
 `fit` (:395) drives bind → init_params → init_optimizer → per-batch
 forward_backward/update/update_metric with callbacks and epoch eval;
 `score`, `predict`, param get/set round out the interface.
+
+API-pinned surface (what downstream code observes and we therefore keep
+bit-identical): method signatures and kwarg defaults; the per-batch hook
+ORDER inside fit (monitor tic → forward_backward → update → prepare(next
+batch) → update_metric → monitor toc → batch_end callbacks) — reference
+callbacks rely on the metric being updated and on `locals` exposing the
+loop state; `BatchEndParam(..., locals=locals())`; the
+`epoch_end_callback(epoch, symbol, arg_params, aux_params)` arity; and
+the "Epoch[N] Train-metric=…" / "Time cost" / "Validation-" log-line
+formats, which ecosystem tooling greps out of training logs; and the
+fetch-AFTER-update iterator discipline (a DataBatch is only guaranteed
+valid until the next next() call, so the next batch is pulled only once
+the current step is done). The loop body below is written as a
+sentinel-driven while over next(it, None) rather than the reference's
+end_of_batch flag dance.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 
@@ -21,18 +37,22 @@ from ..initializer import Uniform
 __all__ = ["BaseModule"]
 
 
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
+
 def _check_input_names(symbol, names, typename, throw):
+    """Validate user-declared input names against the symbol's arguments
+    (role of the reference helper at base_module.py:44; wording ours)."""
     args = symbol.list_arguments()
+    declared = set(args)
     for name in names:
-        if name in args:
+        if name in declared:
             continue
-        candidates = [arg for arg in args if not arg.endswith("_weight")
-                      and not arg.endswith("_bias") and not arg.endswith("_gamma")
-                      and not arg.endswith("_beta")]
-        msg = (f"\033[91mYou created Module with Module(..., {typename}_names"
-               f"={names}) but input with name {name!r} is not found in "
-               f"symbol.list_arguments(). Did you mean one of:\n\t%s\033[0m"
-               % "\n\t".join(candidates))
+        likely_inputs = [a for a in args
+                         if not a.endswith(_PARAM_SUFFIXES)]
+        msg = (f"{typename}_names={list(names)!r} declares {name!r}, which "
+               f"is not among the symbol's arguments. Arguments that look "
+               f"like inputs (non-parameters): {likely_inputs}")
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
@@ -62,6 +82,22 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Shared eval-iteration core for score/predict: (index, batch,
+        unpadded outputs) triples after an inference forward."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        batches = eval_data if num_batch is None \
+            else itertools.islice(eval_data, num_batch)
+        for i, batch in enumerate(batches):
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            if batch.pad:
+                # iterator tail-padding: drop the replicated rows
+                outs = [o[:o.shape[0] - batch.pad] for o in outs]
+            yield i, batch, outs
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -72,69 +108,47 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
+        callbacks = _as_list(batch_end_callback)
+        count = 0
+        batches = eval_data if num_batch is None \
+            else itertools.islice(eval_data, num_batch)
+        for nbatch, eval_batch in enumerate(batches):
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            for callback in callbacks:
+                callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                       eval_metric=eval_metric,
+                                       locals=locals()))
+            count = nbatch + 1
+        for callback in _as_list(score_end_callback):
+            callback(BatchEndParam(epoch=epoch, nbatch=count,
+                                   eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        for i, batch, outs in self._eval_batches(eval_data, num_batch,
+                                                 reset):
+            yield (outs, i, batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
         """Run prediction, collecting (merged) outputs (base_module.py
-        predict)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                if len(out) != num_outputs:
-                    raise ValueError("Cannot merge batches, as num of "
-                                     "outputs is not the same in mini-"
-                                     "batches. Maybe bucketing is used?")
-            from ..ndarray.ndarray import concatenate
-            output_list2 = [concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        predict). No defensive copy is needed per batch: slicing on the
+        immutable-functional substrate already yields independent arrays."""
+        per_batch = [outs for (_, _, outs)
+                     in self._eval_batches(eval_data, num_batch, reset)]
+        if not per_batch or not merge_batches:
+            return per_batch
+        widths = {len(outs) for outs in per_batch}
+        if len(widths) != 1:
+            raise ValueError(
+                "Cannot merge batches: output count varies across "
+                "mini-batches (bucketing?). Call with merge_batches=False.")
+        from ..ndarray.ndarray import concatenate
+        merged = [concatenate(cols) for cols in zip(*per_batch)]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -163,62 +177,64 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        # training loop
+        batch_callbacks = _as_list(batch_end_callback)
+        epoch_callbacks = _as_list(epoch_end_callback)
+
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            epoch_start = time.time()
             eval_metric.reset()
-            nbatch = 0
             data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            # iterator contract: a DataBatch is only guaranteed valid until
+            # the next next() call (legacy buffer-reusing iterators), so
+            # batch N+1 is fetched only AFTER batch N's forward/update
+            data_batch = next(data_iter, None)
+            nbatch = 0
+            while data_batch is not None:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
+                upcoming = next(data_iter, None)
+                if upcoming is not None:
+                    # hand the next batch to the prefetch hook while this
+                    # step's arrays are still settling (async dispatch)
+                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+                # contract: callbacks fire AFTER the metric update and see
+                # the loop state through `locals` (Speedometer & friends)
+                if batch_callbacks:
+                    cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                             eval_metric=eval_metric,
+                                             locals=locals())
+                    for callback in batch_callbacks:
+                        callback(cb_param)
+                data_batch = upcoming
                 nbatch += 1
 
-            # one epoch of training is finished
+            # log-format contract: "Epoch[N] Train-<metric>=<val>" lines
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - epoch_start)
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+            # round-trip params through get/set: commits device values to
+            # the host-visible dicts checkpoints and callbacks read
+            snapshot_args, snapshot_aux = self.get_params()
+            self.set_params(snapshot_args, snapshot_aux)
+            for callback in epoch_callbacks:
+                callback(epoch, self.symbol, snapshot_args, snapshot_aux)
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
-
-            # evaluation on validation set
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
 
-            # end of epoch, reset the data-iter for another epoch
             train_data.reset()
 
     # -- symbol/params interface (implemented by subclasses) -----------------

@@ -141,6 +141,50 @@ def test_losses_values():
     np.testing.assert_allclose(hl, [0.5], rtol=1e-5)
 
 
+def test_trainer_unique_rewrapped_param():
+    """_unique must dedup on the underlying device buffer, not wrapper
+    identity: a re-wrapped NDArray around the same jax array is the same
+    gradient and must not be summed twice."""
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.gluon.trainer import Trainer
+    g = mx.nd.ones((3,))
+    rewrap = NDArray(g._data)          # same buffer, fresh wrapper
+    assert rewrap is not g
+    assert len(Trainer._unique([g, rewrap])) == 1
+    # distinct buffers must NOT dedup
+    assert len(Trainer._unique([mx.nd.ones((3,)), mx.nd.ones((3,))])) == 2
+
+    # end-to-end: two-ctx mesh param with one ctx slot re-wrapped; the
+    # kvstore must still see the gradient exactly once
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    p = gluon.Parameter("w", shape=(4,))
+    p.initialize(init=mx.init.One(), ctx=ctxs)
+    trainer = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 0.1})
+    p.list_grad()[0][:] = 1.0
+    # rewrap slot 0 so the UNMARKED rewrap becomes the dedup
+    # representative and the autograd-marked original the alias — the
+    # nastiest ordering: the alias's captured leaf value must be
+    # refreshed too (_rebind), not just its _data
+    c0, c1 = p.list_ctx()
+    p._data[c0] = NDArray(p._data[c1]._data)
+    p._grad[c0] = NDArray(p._grad[c1]._data)
+    trainer.step(1)
+    want = np.full(4, 0.9, np.float32)
+    # the update applied exactly once, and NO ctx slot is left stale
+    np.testing.assert_allclose(p.data(c0).asnumpy(), want, rtol=1e-6)
+    np.testing.assert_allclose(p.data(c1).asnumpy(), want, rtol=1e-6)
+    # marked wrappers' autograd leaf value tracks the rebound buffer
+    from mxnet_tpu import autograd as ag
+    for ctx in (c0, c1):
+        w = p.data(ctx)
+        if isinstance(w._ag_node, ag.AGVar):
+            assert w._ag_node.value is w._data
+    # second step keeps them in lockstep (grad wrappers re-synced too)
+    trainer.step(1)
+    np.testing.assert_allclose(p.data(c0).asnumpy(),
+                               p.data(c1).asnumpy(), rtol=0)
+
+
 def test_ctc_loss_forwards_lengths():
     # gluon CTCLoss must pass pred/label lengths through to the op:
     # truncated-length results must match slicing the inputs by hand
