@@ -21,6 +21,7 @@ from .registry import Param, register
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
+_LSE_LANES = 8    # minor replication of the per-row lse (TPU block tiling)
 
 
 def _t(*o):
@@ -41,9 +42,10 @@ def reference_attention(q, k, v, causal=False, scale=None):
         q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
-                  scale):
-    """One (bh, q-block) grid cell: stream K/V blocks with online softmax."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+                  causal, scale):
+    """One (bh, q-block) grid cell: stream K/V blocks with online softmax.
+    Also writes the per-row logsumexp — the backward's saved statistic."""
     import jax.experimental.pallas as pl
 
     q_block = q_ref.shape[0]
@@ -90,12 +92,122 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
         return acc_new, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
-    l = jnp.where(l == 0, 1.0, l)
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    # rows with no valid key (can't happen for the supported self-attention
+    # shapes, but keep the statistic total): lse=+inf makes every backward
+    # p = exp(s - lse) collapse to 0, matching the zero forward output.
+    # The row statistic is replicated across a minor dim of 8 — the
+    # smallest lane count the TPU lowering accepts for a blocked store
+    lse = jnp.where(l == 0, jnp.inf, m + jnp.log(l_safe))
+    lse_ref[:] = jnp.broadcast_to(lse, (q_block, _LSE_LANES))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                         dq_ref, *, block_k, seq_len, causal, scale):
+    """dQ for one (bh, q-block): stream K/V. With the saved lse the
+    softmax re-materializes blockwise (p = exp(s - lse)) — no (S, S)
+    tensor ever exists; delta = rowsum(dO * O) is recomputed in-VMEM from
+    the O/dO blocks (cheaper than a third saved row array)."""
+    import jax.experimental.pallas as pl
+
+    q_block = q_ref.shape[0]
+    q = q_ref[:]
+    do = do_ref[:].astype(jnp.float32)                  # (Bq, D)
+    lse = lse_ref[:, 0:1]                               # (Bq, 1)
+    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=1,
+                    keepdims=True)                      # (Bq, 1)
+    q_start = pl.program_id(1) * q_block
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, 1), 0)
+
+    n_blocks = seq_len // block_k
+    if causal:
+        n_blocks = jnp.minimum(
+            n_blocks, (q_start + q_block + block_k - 1) // block_k)
+
+    def body(i, dq_acc):
+        start = i * block_k
+        k_blk = k_ref[pl.dslice(start, block_k), :]
+        v_blk = v_ref[pl.dslice(start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+        if causal:
+            k_pos = start + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)                             # masked rows -> 0
+        dp = jax.lax.dot_general(
+            do, v_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Bq, Bk)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Bq, D)
+
+    dq = jax.lax.fori_loop(0, n_blocks,
+                           body, jnp.zeros(q.shape, jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, *, block_q, seq_len, causal,
+                          scale):
+    """dK/dV for one (bh, k-block): stream Q/dO/O blocks. Causal skip from
+    the other side — q-blocks strictly above this k-block see none of it
+    (fori_loop lower bound derived from the grid position)."""
+    import jax.experimental.pallas as pl
+
+    block_k = k_ref.shape[0]
+    k = k_ref[:]                                        # (Bk, D)
+    v = v_ref[:]
+    k_start = pl.program_id(1) * block_k
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    first_block = k_start // block_q if causal else 0
+    n_blocks = seq_len // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        start = i * block_q
+        q_blk = q_ref[pl.dslice(start, block_q), :]      # (Bq, D)
+        do_blk = do_ref[pl.dslice(start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(start, block_q), 0:1]    # (Bq, 1)
+        delta = jnp.sum(
+            do_blk * o_ref[pl.dslice(start, block_q), :].astype(
+                jnp.float32), axis=1, keepdims=True)     # (Bq, 1)
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+        if causal:
+            q_pos = start + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (block_q, 1), 0)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        # dV += P^T dO  (contract over the q rows)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Bk, D)
+        dp = jax.lax.dot_general(
+            do_blk, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Bq, Bk)
+        ds = p * (dp - delta) * scale
+        # dK += dS^T Q
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (Bk, D)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros(k.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_block, n_blocks, body, (z, z))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _flash_pallas(q, k, v, causal, scale, interpret=False):
-    """q/k/v (B, H, S, D) with S % block == 0 and D % 128 == 0."""
+    """Forward kernel. q/k/v (B, H, S, D) with S % block == 0 and
+    D % 128 == 0 (or 64). Returns (out (B,H,S,D), lse (B*H, S, 8) f32 —
+    the row statistic lane-replicated for TPU block tiling)."""
     import jax.experimental.pallas as pl
 
     b, h, s, d = q.shape
@@ -106,7 +218,7 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
     vf = v.reshape(b * h, s, d)
     kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=s,
                                causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q),
         in_specs=[
@@ -114,12 +226,79 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
             pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi:
-                               (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, _LSE_LANES),
+                         lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, _LSE_LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse
+
+
+def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
+    """Recompute-based flash backward: two single-HBM-pass kernels (dQ
+    gridded over q-blocks; dK/dV over k-blocks) re-derive the softmax
+    from the saved lse — O(S) extra memory, never an (S, S) tensor."""
+    import jax.experimental.pallas as pl
+
+    b, h, s, d = q.shape
+    block_q = min(_BLOCK_Q, s)
+    block_k = min(_BLOCK_K, s)
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    dof = g.reshape(b * h, s, d)
+    of = o.reshape(b * h, s, d)
+
+    full_spec = pl.BlockSpec((None, s, d), lambda bh, i: (bh, 0, 0))
+    lse_full = pl.BlockSpec((None, s, _LSE_LANES), lambda bh, i: (bh, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          seq_len=s, causal=causal, scale=scale),
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            full_spec, full_spec,
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, _LSE_LANES),
+                         lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, of, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          seq_len=s, causal=causal, scale=scale),
+        grid=(b * h, s // block_k),
+        in_specs=[
+            full_spec,
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            full_spec, full_spec, lse_full,
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, of, lse)
+
+    shape = (b, h, s, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
 def _pallas_eligible(q, k, platform=None):
@@ -141,24 +320,26 @@ def _pallas_eligible(q, k, platform=None):
 
 
 def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):
-    """Pallas forward + XLA-derived backward: the blockwise kernel has no
-    hand-written transpose, so the vjp recomputes through the dense XLA
-    formulation (identical math) — forward inference gets the kernel,
-    training pays one dense backward."""
+    """Pallas forward + Pallas recompute-based backward (FlashAttention-2
+    style): the forward saves only O and the per-row logsumexp; the
+    backward re-materializes softmax blocks from them in VMEM. Activation
+    memory is O(B*H*S*D + B*H*S), never O(S^2) — the long-context
+    training path."""
 
     @jax.custom_vjp
     def fn(q, k, v):
-        return _flash_pallas(q, k, v, causal, scale, interpret=interpret)
+        out, _ = _flash_pallas(q, k, v, causal, scale, interpret=interpret)
+        return out
 
     def fwd(q, k, v):
-        return fn(q, k, v), (q, k, v)
+        out, lse = _flash_pallas(q, k, v, causal, scale,
+                                 interpret=interpret)
+        return out, (q, k, v, out, lse)
 
     def bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda a, b, c: reference_attention(a, b, c, causal, scale),
-            q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        return _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale,
+                                 interpret=interpret)
 
     fn.defvjp(fwd, bwd)
     return fn(q, k, v)
