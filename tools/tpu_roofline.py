@@ -73,6 +73,7 @@ def analyze(trace_dir, steps, batch):
         if e.get("ph") == "M" and e.get("name") == "process_name":
             pid_names[e["pid"]] = e["args"].get("name")
     agg = collections.defaultdict(lambda: [0, 0, 0, 0])
+    per_op = collections.defaultdict(lambda: [0, 0, 0, 0])
     for e in ev:
         if e.get("ph") != "X":
             continue
@@ -82,15 +83,17 @@ def analyze(trace_dir, steps, batch):
         if "hlo_category" not in a:
             continue
         cat = a["hlo_category"]
-        r = agg[cat]
-        r[0] += int(a.get("device_duration_ps", 0))
-        r[1] += int(a.get("model_flops", 0) or 0)
-        # -start events report the same raw_bytes_accessed as their -done
-        # counterpart (one DMA, two trace events) — count bytes only on
-        # completion so totals aren't double-counted
-        if not cat.endswith("-start") and cat != "async-start":
-            r[2] += int(a.get("raw_bytes_accessed", 0) or 0)
-        r[3] += 1
+        # two rollups, one rule set: by category, and per-HLO (keyed by
+        # instruction name so the same op accumulates across steps)
+        for r in (agg[cat], per_op[(e.get("name"), cat)]):
+            r[0] += int(a.get("device_duration_ps", 0))
+            r[1] += int(a.get("model_flops", 0) or 0)
+            # -start events report the same raw_bytes_accessed as their
+            # -done counterpart (one DMA, two trace events) — count bytes
+            # only on completion so totals aren't double-counted
+            if not cat.endswith("-start") and cat != "async-start":
+                r[2] += int(a.get("raw_bytes_accessed", 0) or 0)
+            r[3] += 1
 
     tot_ps = sum(v[0] for v in agg.values())
     tot_flops = sum(v[1] for v in agg.values())
@@ -124,6 +127,22 @@ def analyze(trace_dir, steps, batch):
         print(f"{c:26s} {d / steps / 1e9:8.2f} {100 * d / tot_ps:6.1f} "
               f"{fl / steps / sec / 1e12:8.1f} {b / steps / sec / 1e9:6.0f} "
               f"{b / steps / 1e9:8.2f} {n // steps:5d}")
+    top = sorted(per_op.items(), key=lambda kv: -kv[1][0])[:40]
+    print(f"\ntop HLOs by device time "
+          f"({'name':s} | cat | ms | GB | TFLOP/s | GB/s):")
+    top_rows = []
+    for (name, cat), (d, fl, b, n) in top:
+        sec = d / steps / 1e12
+        if sec <= 0:
+            continue
+        top_rows.append({
+            "name": name, "category": cat, "ms": d / steps / 1e9,
+            "gb": b / steps / 1e9,
+            "tflops": fl / steps / sec / 1e12,
+            "gbps": b / steps / sec / 1e9})
+        print(f"  {name[:72]:72s} {cat:18s} {d / steps / 1e9:6.2f} "
+              f"{b / steps / 1e9:6.2f} {fl / steps / sec / 1e12:6.1f} "
+              f"{b / steps / sec / 1e9:6.0f}")
     return {
         "step_ms": step_s * 1e3,
         "tflop_per_step": tot_flops / steps / 1e12,
@@ -131,6 +150,7 @@ def analyze(trace_dir, steps, batch):
         "mfu": tot_flops / steps / step_s / V5E_PEAK_FLOPS,
         "hbm_floor_ms": tot_bytes / steps / V5E_HBM_BW * 1e3,
         "categories": rows,
+        "top_hlos": top_rows,
     }
 
 
