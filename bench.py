@@ -262,14 +262,28 @@ def _flash_attention_tokens_per_sec(batch=8, heads=8, seq=4096, dim=128):
     return sorted(rates)[1], flops / (batch * seq)   # per token
 
 
+ACC_TARGET = 0.97
+
+
 def _accuracy_lane():
     """End-to-end convergence on the chip: LeNet on sklearn's bundled
     handwritten digits (the zero-egress stand-in for the reference's MNIST
     trainer-integration tier, tests/python/train/test_conv.py; same models
     asserted >0.97 in tests/test_train_accuracy.py on CPU). Returns the
-    held-out accuracy actually reached on the TPU."""
+    held-out accuracy actually reached on the TPU.
+
+    Round-4 diagnosis of the r3 driver artifact (0.9635 < 0.97): the
+    lane was UNSEEDED — np.random state inherited from whatever ran
+    before in bench.py decided the Xavier draws and shuffle order, and
+    an unlucky draw lands below the bar. Seeded runs on the chip with
+    DEFAULT matmul precision scored 0.9792 / 0.9870 (seeds 0/1) — TPU
+    numerics were not the cause. The lane is now seeded, runs two extra
+    epochs of margin, and ASSERTS the target instead of just reporting
+    (a silent sub-bar number is a regression, not a result)."""
     import mxnet_tpu as mx
     from sklearn.datasets import load_digits
+    np.random.seed(0)
+    mx.random.seed(0)
     d = load_digits()
     x = (d.data.astype(np.float32) / 16.0)
     y = d.target.astype(np.float32)
@@ -298,11 +312,16 @@ def _accuracy_lane():
     vit = mx.io.NDArrayIter(xv, yv, batch_size=64,
                             label_name="softmax_label")
     mod = mx.mod.Module(sym, context=mx.tpu(0))
-    mod.fit(it, num_epoch=12, optimizer="sgd",
+    mod.fit(it, num_epoch=14, optimizer="sgd",
             optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
             initializer=mx.init.Xavier())
     vit.reset()
-    return float(dict(mod.score(vit, mx.metric.Accuracy()))["accuracy"])
+    acc = float(dict(mod.score(vit, mx.metric.Accuracy()))["accuracy"])
+    if acc < ACC_TARGET:
+        raise AssertionError(
+            f"accuracy lane FAILED: {acc:.4f} < {ACC_TARGET} "
+            "(seeded config; see _accuracy_lane docstring)")
+    return acc
 
 
 def main():
@@ -374,8 +393,14 @@ def main():
         fa_mfu = _mfu(fa_tps, fa_unit_flops)
     except Exception as e:
         fa_tps, fa_mfu = f"unavailable: {type(e).__name__}", None
+    acc_fail = None
     try:
         acc_lane = round(_accuracy_lane(), 4)
+    except AssertionError as e:
+        # below-target accuracy FAILS the bench (nonzero exit after the
+        # JSON line) instead of being silently recorded
+        acc_lane = str(e)
+        acc_fail = str(e)
     except Exception as e:
         acc_lane = f"unavailable: {type(e).__name__}"
 
@@ -410,6 +435,8 @@ def main():
         "timing": "median-of-3x20-steps",
         "secondary_lane_timing": "median-of-3x10-steps (rn152/lstm/attn)",
     }))
+    if acc_fail:
+        raise SystemExit(f"bench FAILED: {acc_fail}")
 
 
 if __name__ == "__main__":

@@ -1,12 +1,13 @@
 """Pallas conv+BN(+ReLU) megakernels for the ResNet hot path.
 
-Role: close the gap between XLA's fusion ceiling and the HBM roofline
-floor measured in docs/perf_analysis_r03.md §6. XLA will not fuse a
-reduction epilogue (BN statistics) into a convolution's output, nor keep
-the normalize/mask chain in VMEM between a conv and its consumer — every
-BatchNorm therefore costs a full extra read pass over the activation
-tensor. These kernels fuse, for the 1x1 convolutions (2/3 of ResNet-50's
-convs, touching its largest tensors):
+Role: built to test round 3's hypothesis (docs/perf_analysis_r03.md §6)
+that XLA would not fuse a reduction epilogue (BN statistics) into a
+convolution's output nor keep the normalize/mask chain in VMEM between
+a conv and its consumer — which, if true, would have made every
+BatchNorm cost a full extra read pass. THE HYPOTHESIS WAS REFUTED BY
+MEASUREMENT (docs/megakernel_r04.md): XLA already performs both
+fusions. The kernels implement, for the 1x1 convolutions (2/3 of
+ResNet-50's convs, touching its largest tensors):
 
   - `conv1x1(want_stats=True)`: y = w @ x with the per-channel sum /
                        sum-of-squares accumulated in VMEM while the

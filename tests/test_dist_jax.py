@@ -1,0 +1,92 @@
+"""Two-process jax.distributed smoke: dist.py's ACTUAL multi-host
+bring-up (GRPC coordinator + cross-process collectives), CPU backend.
+
+Reference role: the ps-lite worker/server van plus tools/launch.py
+multi-node dispatch (SURVEY.md §2.3). The other dist tests
+(test_dist_kvstore/test_dist_fit) validate kvstore VALUES over worker
+processes; this one pins the transport layer itself: jax.distributed
+initializes from the DMLC_* env contract, jax.process_count() sees the
+gang, host collectives (allreduce/broadcast/barrier) agree, and a
+JITTED computation over a cross-process device mesh runs a real psum
+over the DCN-analog channel.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+sys.path.insert(0, os.environ["T_REPO"])
+from mxnet_tpu import dist
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+out_dir = sys.argv[1]
+
+# bring-up from the DMLC env contract (what tools/launch.py exports)
+assert dist.init_process_group() is True
+assert dist.is_initialized()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == rank
+
+# host-level collectives
+total = dist.allreduce_sum(np.full((3,), float(rank + 1), np.float32))
+np.testing.assert_allclose(total, np.full((3,), 3.0))
+
+got = dist.broadcast_from_root(np.full((2,), 7.0 if rank == 0 else -1.0,
+                                       np.float32))
+np.testing.assert_allclose(got, np.full((2,), 7.0))
+
+dist.barrier("smoke")
+
+# compiled cross-process psum: one global mesh spanning both processes,
+# each process feeds its local shard, the jitted sum crosses the
+# process boundary (the DCN code path on a pod)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import jax.numpy as jnp
+devs = np.array(jax.devices())
+assert len(devs) == 2   # one cpu device per process
+mesh = Mesh(devs, ("dp",))
+sharding = NamedSharding(mesh, P("dp"))
+local = np.full((4,), float(rank + 1), np.float32)
+garr = jax.make_array_from_process_local_data(sharding, local)
+assert garr.shape == (8,)
+total = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(garr)
+assert float(total) == 4 * 1.0 + 4 * 2.0, float(total)
+
+with open(os.path.join(out_dir, f"jd_ok_{rank}"), "w") as f:
+    f.write("pass")
+print(f"worker {rank}: PASS", flush=True)
+"""
+
+
+def test_two_process_jax_distributed_smoke():
+    with tempfile.TemporaryDirectory() as td:
+        worker = os.path.join(td, "jd_worker.py")
+        with open(worker, "w") as f:
+            f.write(WORKER)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["T_REPO"] = REPO
+        env["JAX_NUM_CPU_DEVICES"] = "1"
+        # the launcher exports DMLC_PS_ROOT_URI/PORT + worker ids — the
+        # same env contract the reference's dmlc tracker provides
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "--launcher", "local",
+             sys.executable, worker, td],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        for r in range(2):
+            assert os.path.exists(os.path.join(td, f"jd_ok_{r}")), \
+                f"worker {r} incomplete:\n{proc.stdout}\n{proc.stderr}"
