@@ -262,6 +262,54 @@ def _flash_attention_tokens_per_sec(batch=8, heads=8, seq=4096, dim=128):
     return sorted(rates)[1], flops / (batch * seq)   # per token
 
 
+def _int8_inference_ips(sym):
+    """INT8 ResNet-50 b32 inference lane. Known SLOWER than bf16 on this
+    chip — XLA's int8 convs run ~3x less byte-efficient than bf16 and
+    the per-layer dequant/requant chains add ~1 GB/batch; the lane exists
+    so the gap stays measured, not assumed (trace evidence and the
+    parking decision: docs/int8_r04.md). Weights are random — ranges come
+    from calibration either way and throughput is weight-agnostic."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.executor import _build_runner
+
+    rng = np.random.RandomState(0)
+    shapes = {"data": (INFER_BATCH, 3, 224, 224),
+              "softmax_label": (INFER_BATCH,)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+    aux_params = {
+        n: mx.nd.array((np.zeros if ("mean" in n or "beta" in n)
+                        else np.ones)(s).astype(np.float32))
+        for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    calib = mx.io.NDArrayIter(
+        rng.uniform(0, 1, (32, 3, 224, 224)).astype(np.float32),
+        np.zeros(32, np.float32), batch_size=INFER_BATCH,
+        label_name="softmax_label")
+    qsym, qargs, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive", calib_data=calib,
+        num_calib_examples=32)
+    run = _build_runner(qsym, is_train=False)
+    tpu = jax.devices()[0]
+    x = jnp.asarray(rng.uniform(0, 1, (INFER_BATCH, 3, 224, 224))
+                    .astype(np.float32))
+    argv = tuple(jax.device_put(
+        qargs[n]._data if n in qargs else
+        (x if n == "data" else jnp.zeros(INFER_BATCH, jnp.float32)), tpu)
+        for n in qsym.list_arguments())
+    auxv = tuple(jax.device_put(qaux[n]._data, tpu)
+                 for n in qsym.list_auxiliary_states())
+    key = jax.device_put(jax.random.PRNGKey(0), tpu)
+    # same timing harness (warmup + host-fetch barrier + median-of-3)
+    # as every other inference lane
+    return _infer_ips(run, argv, auxv, key)[0]
+
+
 ACC_TARGET = 0.97
 
 
@@ -393,6 +441,10 @@ def main():
         fa_mfu = _mfu(fa_tps, fa_unit_flops)
     except Exception as e:
         fa_tps, fa_mfu = f"unavailable: {type(e).__name__}", None
+    try:
+        int8_ips = round(_int8_inference_ips(sym), 2)
+    except Exception as e:
+        int8_ips = f"unavailable: {type(e).__name__}"
     acc_fail = None
     try:
         acc_lane = round(_accuracy_lane(), 4)
@@ -423,6 +475,10 @@ def main():
         "inference_vs_baseline": round(infer_ips / K80_RN50_INFER_B32, 2),
         "inference_bf16_vs_baseline": round(
             infer16_ips / K80_RN50_INFER_B32, 2),
+        # int8 loses to bf16 on this chip BY MEASUREMENT — reported so
+        # the gap stays visible; parked with trace evidence in
+        # docs/int8_r04.md
+        "int8_inference_b32_ips": int8_ips,
         "resnet152_train_ips_b64": rn152_ips,
         "resnet152_vs_k80": round(rn152_ips / K80_RN152_TRAIN, 2)
         if isinstance(rn152_ips, float) else None,

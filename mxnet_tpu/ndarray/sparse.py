@@ -1,11 +1,16 @@
 """Sparse NDArray API (parity surface for python/mxnet/ndarray/sparse.py).
 
-TPU-honest design (SURVEY.md §7 stage 11): TPU/XLA has no efficient sparse
-storage, so `row_sparse` and `csr` are *dense-backed views with sparse
-metadata*. The API (indices/indptr/data accessors, tostype, retain) is
-preserved so kvstore row_sparse paths and tests run; compute falls back to
-dense XLA ops, which on TPU is usually faster than emulated gather/scatter
-for the reference's workloads anyway.
+TPU-honest design (SURVEY.md §7 stage 11): TPU/XLA has no native sparse
+STORAGE format, so `row_sparse` and `csr` stay *dense-backed views with
+sparse metadata* — every dense op keeps working. COMPUTE, however, is
+real when the array was built from sparse components: construction from
+a (data, indices[, indptr]) triplet retains device-resident ELL
+components (ops/sparse_ops.py), and `sparse.dot` / the optimizers'
+row_sparse lazy path dispatch to gather/scatter kernels whose work
+scales with nnz instead of the dense shape (reference kernels:
+src/operator/tensor/dot-inl.h, src/operator/optimizer_op.cc sparse
+variants). Measured crossover vs dense on the real chip:
+tools/sparse_bench.py + PARITY.md.
 """
 from __future__ import annotations
 
@@ -16,7 +21,23 @@ from .ndarray import NDArray, array, zeros
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ()
+    # sparse components (device arrays) when constructed from sparse
+    # parts; None when the array is a plain dense-backed view.
+    # CSR: (val (R,K) ELL, idx (R,K), counts (R,) nnz per row);
+    # row_sparse: (data (N,...), row_indices (N,))
+    __slots__ = ("_ell",)
+
+    def __init__(self, data, ell=None):
+        super().__init__(data)
+        self._ell = ell
+
+    def _rebind(self, data, ag_node=None):
+        # any in-place mutation of the dense backing (+=, [:]=, copyto)
+        # invalidates the retained components — dropping them demotes
+        # the array to the dense-backed slow path instead of letting
+        # .data/.indices or the optimizer scatter path read stale values
+        self._ell = None
+        super()._rebind(data, ag_node)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -26,23 +47,33 @@ class CSRNDArray(BaseSparseNDArray):
     def stype(self):
         return "csr"
 
+    def _csr_parts(self):
+        """(data, indices, indptr) numpy triplet — from the retained
+        components when present (explicit zeros preserved, exact
+        round-trip), else re-derived from the dense backing."""
+        if self._ell is not None:
+            val, idx, counts = (_np.asarray(x) for x in self._ell)
+            keep = _np.arange(val.shape[1])[None, :] < counts[:, None]
+            indptr = _np.concatenate(
+                [[0], _np.cumsum(counts)]).astype(_np.int64)
+            return val[keep], idx[keep].astype(_np.int64), indptr
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        indptr = _np.concatenate([[0], _np.cumsum(counts)])
+        # np.nonzero walks row-major, exactly CSR order
+        return a[a != 0], _np.nonzero(a)[1], indptr
+
     @property
     def indices(self):
-        a = self.asnumpy()
-        # vectorized: np.nonzero walks row-major, exactly CSR order
-        return array(_np.nonzero(a)[1], dtype="int64")
+        return array(self._csr_parts()[1], dtype="int64")
 
     @property
     def indptr(self):
-        a = self.asnumpy()
-        counts = (a != 0).sum(axis=1)
-        return array(_np.concatenate([[0], _np.cumsum(counts)]),
-                     dtype="int64")
+        return array(self._csr_parts()[2], dtype="int64")
 
     @property
     def data(self):
-        a = self.asnumpy()
-        return array(a[a != 0])
+        return array(self._csr_parts()[0])
 
     def tostype(self, stype):
         if stype == "default":
@@ -61,12 +92,19 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     @property
     def indices(self):
+        if self._ell is not None:
+            # TRUE index list (explicit zero rows preserved — the
+            # divergence ops/optimizer_ops.py:_row_mask documents only
+            # applies to dense-backed arrays without components)
+            return array(_np.asarray(self._ell[1]), dtype="int64")
         a = self.asnumpy().reshape(self.shape[0], -1)
         nz = _np.nonzero((a != 0).any(axis=1))[0]
         return array(nz, dtype="int64")
 
     @property
     def data(self):
+        if self._ell is not None:
+            return NDArray(self._ell[0])
         a = self.asnumpy()
         nz = _np.nonzero((a.reshape(a.shape[0], -1) != 0).any(axis=1))[0]
         return array(a[nz])
@@ -80,7 +118,10 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    """Create a CSRNDArray from (data, indices, indptr) or dense source."""
+    """Create a CSRNDArray from (data, indices, indptr) or dense source.
+    The triplet form also retains ELL components on device, enabling the
+    gather-based `sparse.dot` fast path."""
+    from ..ops import sparse_ops as sp
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
@@ -92,28 +133,69 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         rows = _np.repeat(_np.arange(shape[0]), _np.diff(indptr))
         dense[rows, indices] = data
         nd = array(dense, ctx=ctx, dtype=dtype)
-    else:
-        nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
-                   dtype=dtype)
-    out = CSRNDArray(nd._data)
-    return out
+        val, idx, counts = sp.ell_from_csr(data, indices, indptr)
+        # components carry the SAME dtype as the dense backing, or the
+        # fast paths would compute at a different precision
+        ell = (array(val, ctx=ctx, dtype=dtype)._data,
+               array(idx, ctx=ctx)._data, counts)
+        return CSRNDArray(nd._data, ell)
+    nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
+               dtype=dtype)
+    return CSRNDArray(nd._data)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray; the (data, indices) form retains the
+    components on device for the scatter-based optimizer fast path."""
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         data = _np.asarray(getattr(data, "asnumpy", lambda: data)())
         indices = _np.asarray(getattr(indices, "asnumpy", lambda: indices)(),
                               dtype=_np.int64)
+        if len(_np.unique(indices)) != len(indices):
+            # format invariant (also assumed by the scatter kernels):
+            # the dense backing keeps last-write-wins while scatter-add
+            # would apply every duplicate — refuse loudly
+            raise MXNetError("row_sparse_array: duplicate row indices")
         full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
         dense = _np.zeros(full_shape,
                           dtype=data.dtype if dtype is None else dtype)
         dense[indices] = data
         nd = array(dense, ctx=ctx, dtype=dtype)
-    else:
-        nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
-                   dtype=dtype)
+        comp = (array(data, ctx=ctx, dtype=dtype)._data,
+                array(indices.astype(_np.int32), ctx=ctx)._data)
+        return RowSparseNDArray(nd._data, comp)
+    nd = array(getattr(arg1, "asnumpy", lambda: arg1)(), ctx=ctx,
+               dtype=dtype)
     return RowSparseNDArray(nd._data)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """sparse.dot — gather-kernel path for dot(csr, dense) and
+    dot(csr.T, dense) when the csr carries ELL components (construction
+    from a triplet); falls back to the dense op otherwise. Reference:
+    dot-inl.h DotCsrDnsDns / DotCsrTransDnsDns.
+
+    Under autograd recording the dense op path is used uncondition-
+    ally: the gather kernel bypasses the tape (it returns a raw device
+    computation), and a silently untaped rhs gradient would be worse
+    than a slower recorded one."""
+    from ..ops import sparse_ops as sp
+    from .ndarray import _invoke
+    from .. import autograd
+    if isinstance(lhs, CSRNDArray) and lhs._ell is not None \
+            and not transpose_b and getattr(rhs, "ndim", 0) == 2 \
+            and not autograd.is_recording() \
+            and rhs.shape[0] == (lhs.shape[0] if transpose_a
+                                 else lhs.shape[1]):
+        val, idx, _counts = lhs._ell
+        if transpose_a:
+            out = sp.ell_dot_t(val, idx, rhs._data, lhs.shape[1])
+        else:
+            out = sp.ell_dot(val, idx, rhs._data)
+        return NDArray(out)
+    return _invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
 
 
 def zeros_sparse(stype, shape, ctx=None, dtype=None):

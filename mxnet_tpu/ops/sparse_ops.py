@@ -1,0 +1,113 @@
+"""Gather/scatter sparse compute — TPU-native row_sparse/CSR kernels.
+
+Role of the reference's sparse kernels (dot(csr,dense)
+src/operator/tensor/dot-inl.h; sparse optimizer kernels
+src/operator/optimizer_op.cc). TPU/XLA has no native sparse formats, so
+the TPU-first realization is the ELL (padded-row) layout: a CSR matrix
+(R, F) with at most K nonzeros per row becomes `val (R, K)` + `idx
+(R, K)` device arrays (rows padded with idx=0/val=0). All kernels are
+static-shaped gathers/scatters XLA lowers to its native dynamic-gather/
+scatter HLOs — compute and memory scale with nnz (R*K), not with the
+dense (R, F) / (F, M) sizes. NDArray-level dispatch lives in
+ndarray/sparse.py; the measured dense-vs-sparse crossover on the real
+chip is recorded in tools/sparse_bench.py + PARITY.md.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+
+def ell_from_csr(data, indices, indptr, pad_to_multiple=8):
+    """Host-side CSR -> ELL conversion, vectorized (no per-row python
+    loop — construction must scale to million-row matrices). Returns
+    (val (R, K), idx (R, K), counts (R,)) with K = max row nnz rounded
+    up for lane friendliness; counts preserves the exact nnz structure
+    (pad entries are indistinguishable from an explicit zero at column
+    0 without it)."""
+    data = _np.asarray(data)
+    indices = _np.asarray(indices, dtype=_np.int32)
+    indptr = _np.asarray(indptr, dtype=_np.int64)
+    rows = len(indptr) - 1
+    counts = _np.diff(indptr).astype(_np.int32)
+    k = int(counts.max()) if rows else 0
+    k = max(1, -(-k // pad_to_multiple) * pad_to_multiple)
+    val = _np.zeros((rows, k), dtype=data.dtype)
+    idx = _np.zeros((rows, k), dtype=_np.int32)
+    nnz = len(data)
+    if nnz:
+        row_of = _np.repeat(_np.arange(rows), counts)
+        slot = _np.arange(nnz) - _np.repeat(indptr[:-1], counts)
+        val[row_of, slot] = data
+        idx[row_of, slot] = indices
+    return val, idx, counts
+
+
+def ell_dot(val, idx, weight):
+    """dot(csr, dense): out[r] = sum_j val[r,j] * weight[idx[r,j]].
+    Padded entries contribute val=0. out (R, M)."""
+    gathered = jnp.take(weight, idx, axis=0)          # (R, K, M)
+    return jnp.einsum("rk,rkm->rm", val.astype(weight.dtype), gathered)
+
+
+def ell_dot_t(val, idx, dense, num_features):
+    """dot(csr.T, dense): out[f] += sum over (r,j) with idx[r,j]==f of
+    val[r,j] * dense[r]. The backward/transpose pattern (dW of a linear
+    layer over sparse inputs). out (F, M) via XLA scatter-add."""
+    r, k = val.shape
+    m = dense.shape[1]
+    contrib = (val.astype(dense.dtype)[..., None]
+               * dense[:, None, :])                   # (R, K, M)
+    out = jnp.zeros((num_features, m), dense.dtype)
+    return out.at[idx.reshape(-1)].add(contrib.reshape(r * k, m))
+
+
+def rows_sgd_update(weight, rows, grad_rows, lr, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse SGD: touch ONLY the listed rows (reference lazy_update
+    sparse kernel semantics — untouched rows skip weight decay too).
+    `rows` must be unique, the row_sparse format invariant (the
+    reference's kernels iterate indices assuming the same)."""
+    g = grad_rows.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
+    upd = -lr * (g + wd * w_rows)
+    return weight.at[rows].add(upd.astype(weight.dtype))
+
+
+def rows_sgd_mom_update(weight, mom, rows, grad_rows, lr, momentum,
+                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse SGD+momentum: momentum decays ONLY on touched rows
+    (reference sgd_mom sparse kernel)."""
+    g = grad_rows.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
+    m_rows = jnp.take(mom, rows, axis=0).astype(jnp.float32)
+    m_new = momentum * m_rows - lr * (g + wd * w_rows)
+    return (weight.at[rows].add(m_new.astype(weight.dtype)),
+            mom.at[rows].set(m_new.astype(mom.dtype)))
+
+
+def rows_adam_update(weight, mean, var, rows, grad_rows, lr, beta1, beta2,
+                     epsilon, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse (lazy) Adam: moments decay ONLY on touched rows
+    (reference adam_update sparse kernel, optimizer_op.cc). Adam-family
+    prep order: rescale -> +wd*w -> clip (ops/optimizer_ops.py
+    _prep_wd_first — decay folds into the grad BEFORE clipping, unlike
+    the SGD family)."""
+    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
+    g = grad_rows.astype(jnp.float32) * rescale_grad + wd * w_rows
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m_rows = jnp.take(mean, rows, axis=0).astype(jnp.float32)
+    v_rows = jnp.take(var, rows, axis=0).astype(jnp.float32)
+    m_new = beta1 * m_rows + (1 - beta1) * g
+    v_new = beta2 * v_rows + (1 - beta2) * g * g
+    step = -lr * m_new / (jnp.sqrt(v_new) + epsilon)
+    return (weight.at[rows].add(step.astype(weight.dtype)),
+            mean.at[rows].set(m_new.astype(mean.dtype)),
+            var.at[rows].set(v_new.astype(var.dtype)))

@@ -179,6 +179,18 @@ def _common_kwargs(opt, index):
     return kwargs
 
 
+def _sparse_components(grad):
+    """(vals, rows) device arrays of a RowSparseNDArray that was built
+    from explicit components (ndarray/sparse.py), else None. Gate for
+    the scatter-based lazy-update fast path: with true components the
+    update touches only nnz rows instead of masking the full table."""
+    ell = getattr(grad, "_ell", None)
+    if ell is None:
+        return None
+    vals, rows = ell
+    return vals, rows
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum; fused on-device updates incl. fp16 master-weight
@@ -220,6 +232,25 @@ class SGD(Optimizer):
             # optimizer.py:498: stype = weight.stype if lazy_update):
             # untouched rows skip decay/momentum (ops/optimizer_ops.py:_lazy)
             lazy = self.lazy_update and grad.stype == "row_sparse"
+            if lazy and _sparse_components(grad) is not None:
+                # scatter fast path: touch only the grad's rows (work
+                # scales with nnz rows, reference sparse sgd kernels)
+                from .ops import sparse_ops as sp
+                vals, rows = _sparse_components(grad)
+                rg = kwargs.get("rescale_grad", 1.0)
+                cg = kwargs.get("clip_gradient", -1.0)
+                if state is not None:
+                    new_w, new_m = sp.rows_sgd_mom_update(
+                        weight._data, state._data, rows, vals, lr,
+                        self.momentum, wd=wd, rescale_grad=rg,
+                        clip_gradient=cg)
+                    weight._rebind(new_w)
+                    state._rebind(new_m)
+                else:
+                    weight._rebind(sp.rows_sgd_update(
+                        weight._data, rows, vals, lr, wd=wd,
+                        rescale_grad=rg, clip_gradient=cg))
+                return
             if state is not None:
                 ndns.sgd_mom_update(weight, grad, state, out=weight,
                                     lr=lr, wd=wd, lazy_update=lazy, **kwargs)
@@ -423,6 +454,19 @@ class Adam(Optimizer):
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
         lazy = self.lazy_update and grad.stype == "row_sparse"
+        if lazy and _sparse_components(grad) is not None:
+            from .ops import sparse_ops as sp
+            vals, rows = _sparse_components(grad)
+            kw = _common_kwargs(self, index)
+            new_w, new_m, new_v = sp.rows_adam_update(
+                weight._data, mean._data, var._data, rows, vals, lr,
+                self.beta1, self.beta2, self.epsilon, wd=wd,
+                rescale_grad=kw.get("rescale_grad", 1.0),
+                clip_gradient=kw.get("clip_gradient", -1.0))
+            weight._rebind(new_w)
+            mean._rebind(new_m)
+            var._rebind(new_v)
+            return
         ndns.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
                          beta1=self.beta1, beta2=self.beta2,
                          epsilon=self.epsilon, lazy_update=lazy,

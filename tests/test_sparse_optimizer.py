@@ -87,3 +87,204 @@ def test_dense_grad_ignores_lazy_flag():
     grad = mx.nd.zeros(shape)   # dense all-zero grad: wd still applies
     opt.update(0, w, grad, None)
     np.testing.assert_allclose(w.asnumpy(), w0 - 0.1 * 0.1 * w0, rtol=1e-6)
+
+
+def _component_grad(shape, rows, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.normal(0, 1, (len(rows),) + shape[1:]).astype(np.float32)
+    return sp.row_sparse_array((data, np.array(rows, np.int64)),
+                               shape=shape), data
+
+
+def test_sgd_scatter_path_matches_masked_path():
+    """Component-built row_sparse grads take the scatter kernel; results
+    must match the dense-masked lazy path bit-for-bit in fp32."""
+    shape, rows = (8, 4), [2, 5, 7]
+    w0 = np.random.RandomState(1).normal(1, 0.1, shape).astype(np.float32)
+    mom0 = np.full(shape, 0.25, np.float32)
+    grad_c, data = _component_grad(shape, rows)
+    assert grad_c._ell is not None
+    grad_d = _row_sparse_grad_from(shape, rows, data)
+
+    outs = []
+    for grad in (grad_c, grad_d):
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.1,
+                               lazy_update=True)
+        w = mx.nd.array(w0)
+        state = mx.nd.array(mom0)
+        opt.update(0, w, grad, state)
+        outs.append((w.asnumpy(), state.asnumpy()))
+    np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-6)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6)
+
+
+def _row_sparse_grad_from(shape, rows, data):
+    dense = np.zeros(shape, np.float32)
+    dense[rows] = data
+    return sp.row_sparse_array(dense)
+
+
+def test_scatter_path_honors_explicit_zero_rows():
+    """Reference index-based semantics: a row PRESENT in indices whose
+    values are exactly zero still gets wd/momentum decay through the
+    component path (the dense-backed value-inferred path cannot see it —
+    the divergence documented at ops/optimizer_ops.py:_row_mask)."""
+    shape = (6, 3)
+    rows = [1, 4]
+    data = np.zeros((2, 3), np.float32)      # explicit all-zero rows
+    grad = sp.row_sparse_array((data, np.array(rows, np.int64)),
+                               shape=shape)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.5,
+                           lazy_update=True)
+    w = mx.nd.array(np.ones(shape, np.float32))
+    state = mx.nd.array(np.zeros(shape, np.float32))
+    opt.update(0, w, grad, state)
+    wn = w.asnumpy()
+    # listed rows decay by wd even with zero grad values
+    np.testing.assert_allclose(wn[rows], 1.0 - 0.1 * 0.5, rtol=1e-6)
+    # unlisted rows bitwise-unchanged
+    assert np.array_equal(wn[[0, 2, 3, 5]], np.ones((4, 3), np.float32))
+
+
+def test_adam_scatter_path_matches_masked_path():
+    shape, rows = (7, 2), [0, 3, 6]
+    w0 = np.random.RandomState(2).normal(0, 1, shape).astype(np.float32)
+    grad_c, data = _component_grad(shape, rows, seed=5)
+    grad_d = _row_sparse_grad_from(shape, rows, data)
+    outs = []
+    for grad in (grad_c, grad_d):
+        opt = mx.optimizer.Adam(learning_rate=0.01, lazy_update=True)
+        w = mx.nd.array(w0)
+        state = opt.create_state(0, w)
+        opt.update(0, w, grad, state)
+        outs.append((w.asnumpy(), state[0].asnumpy(), state[1].asnumpy()))
+    for a, b in zip(outs[0], outs[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_dot_gather_path():
+    """sparse.dot over ELL components matches dense dot, both direct and
+    transposed (DotCsrDnsDns / DotCsrTransDnsDns roles)."""
+    rng = np.random.RandomState(3)
+    r, f, m = 5, 32, 4
+    dense_lhs = np.zeros((r, f), np.float32)
+    for i in range(r):
+        cols = rng.choice(f, size=rng.randint(1, 6), replace=False)
+        dense_lhs[i, cols] = rng.normal(0, 1, len(cols))
+    import scipy.sparse as sps
+    csr = sps.csr_matrix(dense_lhs)
+    lhs = sp.csr_matrix((csr.data, csr.indices, csr.indptr), shape=(r, f))
+    assert lhs._ell is not None
+    rhs = mx.nd.array(rng.normal(0, 1, (f, m)).astype(np.float32))
+    got = sp.dot(lhs, rhs).asnumpy()
+    np.testing.assert_allclose(got, dense_lhs @ rhs.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    rhs_t = mx.nd.array(rng.normal(0, 1, (r, m)).astype(np.float32))
+    got_t = sp.dot(lhs, rhs_t, transpose_a=True).asnumpy()
+    np.testing.assert_allclose(got_t, dense_lhs.T @ rhs_t.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    # dense-backed csr (no components) falls back to the dense op
+    lhs_nb = sp.csr_matrix(dense_lhs)
+    assert lhs_nb._ell is None
+    got_nb = sp.dot(lhs_nb, rhs).asnumpy()
+    np.testing.assert_allclose(got_nb, got, rtol=1e-5, atol=1e-5)
+
+
+def test_adam_scatter_path_wd_clip_order_matches_dense():
+    """Adam-family prep order (rescale -> +wd*w -> clip) must hold on the
+    scatter path too — with wd and clip both set the two orders move the
+    weight in OPPOSITE directions for large grads."""
+    shape, rows = (4, 1), [1]
+    w0 = np.full(shape, 3.0, np.float32)
+    data = np.full((1, 1), -2.0, np.float32)
+    grad_c = sp.row_sparse_array((data, np.array(rows, np.int64)),
+                                 shape=shape)
+    grad_d = _row_sparse_grad_from(shape, rows, data)
+    outs = []
+    for grad in (grad_c, grad_d):
+        opt = mx.optimizer.Adam(learning_rate=0.01, wd=0.5,
+                                clip_gradient=1.0, lazy_update=True)
+        w = mx.nd.array(w0)
+        state = opt.create_state(0, w)
+        opt.update(0, w, grad, state)
+        outs.append(w.asnumpy())
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+    # wd folds in BEFORE clip: (-2 + 1.5) = -0.5, not clip(-2)+1.5 = +0.5
+    assert outs[0][1, 0] > 3.0, outs[0][1, 0]
+
+
+def test_components_invalidated_by_inplace_mutation():
+    """In-place ops on a component-built sparse array must drop the
+    retained components — otherwise the optimizer scatter path would
+    consume stale pre-mutation values."""
+    shape, rows = (6, 3), [1, 4]
+    data = np.ones((2, 3), np.float32)
+    g = sp.row_sparse_array((data, np.array(rows, np.int64)), shape=shape)
+    assert g._ell is not None
+    g *= 0.5                      # the standard grad-rescale pattern
+    assert g._ell is None         # demoted to dense-backed
+    w = mx.nd.array(np.zeros(shape, np.float32))
+    opt = mx.optimizer.SGD(learning_rate=1.0, lazy_update=True)
+    opt.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy()[rows], -0.5, rtol=1e-6)
+
+
+def test_sparse_dot_records_gradients():
+    """Under autograd the ELL fast path must yield to the taped dense op
+    so rhs gradients flow."""
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(0)
+    dense_lhs = np.zeros((3, 8), np.float32)
+    dense_lhs[0, 2] = 1.0
+    dense_lhs[2, 5] = 2.0
+    import scipy.sparse as sps
+    csr = sps.csr_matrix(dense_lhs)
+    lhs = sp.csr_matrix((csr.data, csr.indices, csr.indptr), shape=(3, 8))
+    rhs = mx.nd.array(rng.normal(0, 1, (8, 2)).astype(np.float32))
+    rhs.attach_grad()
+    with autograd.record():
+        out = sp.dot(lhs, rhs)
+        loss = out.sum()
+    loss.backward()
+    g = rhs.grad.asnumpy()
+    assert np.abs(g).sum() > 0
+    np.testing.assert_allclose(g, dense_lhs.sum(axis=0)[:, None]
+                               * np.ones((1, 2)), rtol=1e-5)
+
+
+def test_csr_components_roundtrip_explicit_zeros():
+    """Triplet-built CSR must round-trip its OWN components — including
+    explicit zero entries the dense backing cannot represent."""
+    data = np.array([1.0, 0.0, 3.0], np.float32)   # explicit 0 at (0,4)
+    indices = np.array([2, 4, 1], np.int64)
+    indptr = np.array([0, 2, 3], np.int64)
+    m = sp.csr_matrix((data, indices, indptr), shape=(2, 8))
+    np.testing.assert_allclose(m.data.asnumpy(), data)
+    np.testing.assert_array_equal(m.indices.asnumpy(), indices)
+    np.testing.assert_array_equal(m.indptr.asnumpy(), indptr)
+
+
+def test_component_dtype_follows_dense_backing():
+    data = np.ones((1, 2), np.float32)
+    g = sp.row_sparse_array((data, np.array([0], np.int64)), shape=(3, 2),
+                            dtype="float16")
+    assert str(g.dtype) == "float16"
+    assert str(g.data.dtype) == "float16"
+
+
+def test_duplicate_row_indices_refused():
+    import pytest
+    data = np.ones((2, 2), np.float32)
+    with pytest.raises(mx.MXNetError, match="duplicate"):
+        sp.row_sparse_array((data, np.array([1, 1], np.int64)),
+                            shape=(4, 2))
+
+
+def test_sparse_dot_shape_mismatch_raises():
+    import pytest
+    data = np.array([1.0], np.float32)
+    m = sp.csr_matrix((data, np.array([2], np.int64),
+                       np.array([0, 1, 1], np.int64)), shape=(2, 32))
+    bad_rhs = mx.nd.ones((16, 4))
+    with pytest.raises(Exception):
+        sp.dot(m, bad_rhs)          # falls to the dense op, which raises
