@@ -204,6 +204,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the input's varying-mesh-axes set, so
+    pallas_call outputs typecheck under shard_map's vma analysis (the
+    kernels are purely shard-local: outputs vary exactly as q does)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_pallas(q, k, v, causal, scale, interpret=False):
     """Forward kernel. q/k/v (B, H, S, D) with S % block == 0 and
     D % 128 == 0 (or 64). Returns (out (B,H,S,D), lse (B*H, S, 8) f32 —
@@ -232,8 +245,8 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
                          lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s, _LSE_LANES), jnp.float32),
+            _sds((b * h, s, d), q.dtype, q),
+            _sds((b * h, s, _LSE_LANES), jnp.float32, q),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -272,7 +285,7 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
         ],
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=_sds((b * h, s, d), q.dtype, q),
         interpret=interpret,
     )(qf, kf, vf, dof, of, lse)
 
@@ -291,8 +304,8 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+            _sds((b * h, s, d), k.dtype, q),
+            _sds((b * h, s, d), v.dtype, q),
         ],
         interpret=interpret,
     )(qf, kf, vf, dof, of, lse)

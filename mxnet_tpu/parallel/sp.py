@@ -129,7 +129,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, axis_name, causal, scale):
+def _ulysses_shard(q, k, v, axis_name, causal, scale, platform):
     # local (B, H, S/n, D) -> all_to_all -> (B, H/n, S, D)
     def seq_to_heads(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -140,7 +140,14 @@ def _ulysses_shard(q, k, v, axis_name, causal, scale):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # local attention rides the flash dispatcher: Pallas fwd+bwd kernels
+    # on TPU (O(S) activation memory — the full-sequence local view is
+    # exactly where flash matters), dense XLA on CPU meshes. `platform`
+    # comes from the MESH's devices, not the process default backend —
+    # a CPU mesh on a TPU-default host must not pick the TPU kernel
+    from ..ops.attention import flash_attention
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                          platform=platform)
     return heads_to_seq(out)
 
 
@@ -162,6 +169,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_shard, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          platform=mesh.devices.flat[0].platform),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
