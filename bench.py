@@ -94,7 +94,15 @@ def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
                          layers=2):
     """LSTM LM training throughput (BASELINE config 4 role: bucketing
     LSTM): fused RNN symbol, full fwd+bwd+update step. Returns
-    (tokens/sec median-of-3, flops/token from XLA cost analysis)."""
+    (tokens/sec median-of-3, flops/token from XLA cost analysis).
+
+    Context for reading the number (measured round 4): the step's DEVICE
+    time is ~2.6 ms (=~800k tok/s) but each python-dispatched step pays
+    ~8 ms of axon-tunnel dispatch for this while-loop-heavy program —
+    4-step-unrolled jit reaches 307k tok/s on identical math. The lane
+    reports the honest python-stepped wall rate; on a locally attached
+    TPU the gap collapses (same effect, smaller, on the flagship lane:
+    wall vs device MFU in docs/perf_analysis_r03.md §5)."""
     import mxnet_tpu as mx
     from mxnet_tpu.parallel import DataParallelTrainer
     data = mx.sym.Variable("data")

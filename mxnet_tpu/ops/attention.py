@@ -29,6 +29,16 @@ def _t(*o):
 
 
 def reference_attention(q, k, v, causal=False, scale=None):
+    """Dense oracle. One implementation shared with the with-lse variant
+    below — the score/mask/softmax math must not fork."""
+    return reference_attention_with_lse(q, k, v, causal, scale)[0]
+
+
+def reference_attention_with_lse(q, k, v, causal=False, scale=None):
+    """Dense oracle returning (out, lse (B,H,S) f32) — the merge
+    statistic blockwise/ring combiners need. Rows with NO valid key get
+    out=0 and lse=-inf (the logsumexp of an empty set), so such a block
+    contributes exactly nothing to a logaddexp merge."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -37,9 +47,16 @@ def reference_attention(q, k, v, causal=False, scale=None):
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
         scores = jnp.where(mask, scores, -jnp.inf)
-    w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(
-        q.dtype)
+    m = jnp.max(scores, axis=-1)
+    safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(scores - safe[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v.astype(jnp.float32)) / l_safe[..., None]
+    lse = jnp.where(l == 0, -jnp.inf, safe + jnp.log(l_safe))
+    return out.astype(q.dtype), lse
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
@@ -94,9 +111,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    # rows with no valid key (can't happen for the supported self-attention
-    # shapes, but keep the statistic total): lse=+inf makes every backward
-    # p = exp(s - lse) collapse to 0, matching the zero forward output.
+    # rows with no valid key (UNREACHABLE for kernel-eligible shapes:
+    # self-attention with s_q == s_k always has the diagonal key): the
+    # +inf sentinel makes every backward p = exp(s - lse) collapse to 0,
+    # matching the zero forward output. NOTE the dense with-lse oracle
+    # uses -inf for empty rows (the merge-correct logsumexp-of-empty
+    # convention) — the two only disagree on rows that cannot exist here.
     # The row statistic is replicated across a minor dim of 8 — the
     # smallest lane count the TPU lowering accepts for a blocked store
     lse = jnp.where(l == 0, jnp.inf, m + jnp.log(l_safe))
@@ -104,11 +124,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                         dq_ref, *, block_k, seq_len, causal, scale):
+                         glse_ref, dq_ref, *, block_k, seq_len, causal,
+                         scale):
     """dQ for one (bh, q-block): stream K/V. With the saved lse the
     softmax re-materializes blockwise (p = exp(s - lse)) — no (S, S)
     tensor ever exists; delta = rowsum(dO * O) is recomputed in-VMEM from
-    the O/dO blocks (cheaper than a third saved row array)."""
+    the O/dO blocks (cheaper than a third saved row array). glse is the
+    lse OUTPUT's cotangent (ring/blockwise merging differentiates
+    through lse): dlse_i/ds_ij = p_ij, so it simply subtracts from the
+    row term — zeros when lse is not a differentiated output."""
     import jax.experimental.pallas as pl
 
     q_block = q_ref.shape[0]
@@ -117,6 +141,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     lse = lse_ref[:, 0:1]                               # (Bq, 1)
     delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=1,
                     keepdims=True)                      # (Bq, 1)
+    if glse_ref is not None:
+        delta = delta - glse_ref[:, 0:1]
     q_start = pl.program_id(1) * q_block
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, 1), 0)
 
@@ -151,8 +177,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                          dk_ref, dv_ref, *, block_q, seq_len, causal,
-                          scale):
+                          glse_ref, dk_ref, dv_ref, *, block_q, seq_len,
+                          causal, scale):
     """dK/dV for one (bh, k-block): stream Q/dO/O blocks. Causal skip from
     the other side — q-blocks strictly above this k-block see none of it
     (fori_loop lower bound derived from the grid position)."""
@@ -176,6 +202,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         delta = jnp.sum(
             do_blk * o_ref[pl.dslice(start, block_q), :].astype(
                 jnp.float32), axis=1, keepdims=True)     # (Bq, 1)
+        if glse_ref is not None:
+            delta = delta - glse_ref[pl.dslice(start, block_q), 0:1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
@@ -253,10 +281,13 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
     return out.reshape(b, h, s, d), lse
 
 
-def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
+def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
+                      g_lse=None):
     """Recompute-based flash backward: two single-HBM-pass kernels (dQ
     gridded over q-blocks; dK/dV over k-blocks) re-derive the softmax
-    from the saved lse — O(S) extra memory, never an (S, S) tensor."""
+    from the saved lse — O(S) extra memory, never an (S, S) tensor.
+    g_lse (B, H, S) is the lse output's cotangent when lse is itself a
+    differentiated output (blockwise/ring merging); None means zeros."""
     import jax.experimental.pallas as pl
 
     b, h, s, d = q.shape
@@ -267,38 +298,62 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
     vf = v.reshape(b * h, s, d)
     dof = g.reshape(b * h, s, d)
     of = o.reshape(b * h, s, d)
+    have_glse = g_lse is not None
+    if have_glse:
+        # the masked-row lse can be +/-inf sentinels; 0*inf would NaN, so
+        # derive the vma-carrying zero from a finitized lse
+        glse_args = (jnp.broadcast_to(
+            g_lse.astype(jnp.float32).reshape(b * h, s, 1),
+            (b * h, s, _LSE_LANES))
+            + 0.0 * jnp.where(jnp.isfinite(lse), lse, 0.0),)
+    else:
+        glse_args = ()
+
+    def _with_optional_glse(kernel, n_lead):
+        """The hot no-glse path passes glse_ref=None statically — no
+        extra HBM stream for the common training backward."""
+        if have_glse:
+            return kernel
+        return functools.partial(
+            lambda *refs, k: k(*refs[:n_lead], None, *refs[n_lead:]),
+            k=kernel)
 
     full_spec = pl.BlockSpec((None, s, d), lambda bh, i: (bh, 0, 0))
     lse_full = pl.BlockSpec((None, s, _LSE_LANES), lambda bh, i: (bh, 0, 0))
+    lse_blk = pl.BlockSpec((None, block_q, _LSE_LANES),
+                           lambda bh, qi: (bh, qi, 0))
 
-    dq = pl.pallas_call(
+    dq_kernel = _with_optional_glse(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          seq_len=s, causal=causal, scale=scale),
+                          seq_len=s, causal=causal, scale=scale), 6)
+    dq = pl.pallas_call(
+        dq_kernel,
         grid=(b * h, s // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
             full_spec, full_spec,
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q, _LSE_LANES),
-                         lambda bh, qi: (bh, qi, 0)),
-        ],
+            lse_blk,
+        ] + ([lse_blk] if have_glse else []),
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda bh, qi: (bh, qi, 0)),
         out_shape=_sds((b * h, s, d), q.dtype, q),
         interpret=interpret,
-    )(qf, kf, vf, dof, of, lse)
+    )(qf, kf, vf, dof, of, lse, *glse_args)
 
-    dk, dv = pl.pallas_call(
+    dkv_kernel = _with_optional_glse(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          seq_len=s, causal=causal, scale=scale),
+                          seq_len=s, causal=causal, scale=scale), 6)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
         grid=(b * h, s // block_k),
         in_specs=[
             full_spec,
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             full_spec, full_spec, lse_full,
-        ],
+        ] + ([lse_full] if have_glse else []),
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -308,7 +363,7 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False):
             _sds((b * h, s, d), v.dtype, q),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, of, lse)
+    )(qf, kf, vf, dof, of, lse, *glse_args)
 
     shape = (b, h, s, d)
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
@@ -330,6 +385,42 @@ def _pallas_eligible(q, k, platform=None):
         return jax.default_backend() not in ("cpu",)
     except Exception:
         return False
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             force=None, platform=None):
+    """(out, lse) variant of flash_attention for blockwise/ring
+    combiners. BOTH outputs are differentiable: the Pallas backward
+    folds the lse cotangent into its row term (glse in the kernels)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    use_pallas = (force in ("pallas", "interpret") or
+                  (force is None and _pallas_eligible(q, k, platform)))
+    if not use_pallas:
+        return reference_attention_with_lse(q, k, v, causal, scale)
+    interpret = force == "interpret"
+    b, h, s, _ = q.shape
+
+    @jax.custom_vjp
+    def fn(q, k, v):
+        out, lse = _flash_pallas(q, k, v, causal, scale,
+                                 interpret=interpret)
+        return out, lse[:, :, 0].reshape(b, h, s)
+
+    def fwd(q, k, v):
+        out, lse = _flash_pallas(q, k, v, causal, scale,
+                                 interpret=interpret)
+        return ((out, lse[:, :, 0].reshape(b, h, s)),
+                (q, k, v, out, lse))
+
+    def bwd(res, cotangents):
+        g_o, g_lse = cotangents
+        q, k, v, out, lse = res
+        return _flash_pallas_bwd(q, k, v, out, lse, g_o, causal, scale,
+                                 interpret=interpret, g_lse=g_lse)
+
+    fn.defvjp(fwd, bwd)
+    return fn(q, k, v)
 
 
 def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):

@@ -6,10 +6,13 @@ first-class: shard the SEQUENCE axis across a mesh axis so context length
 scales with chip count.
 
   - `ring_attention`: each device holds a sequence shard of Q/K/V; K/V
-    blocks rotate around the ring with `jax.lax.ppermute` while a
-    numerically-stable online softmax accumulates — N steps of
-    compute/communication overlap on ICI, never materializing the full
-    (S, S) score matrix (blockwise attention).
+    blocks rotate around the ring with `jax.lax.ppermute` while per-block
+    results merge with a numerically-stable logsumexp combine — N steps
+    of compute/communication overlap on ICI, never materializing the
+    full (S, S) score matrix. On TPU meshes each (Q, K/V-block) pair
+    runs the Pallas flash kernels fwd+bwd (impl='flash': O(S_local)
+    memory, lse-differentiable merge); CPU meshes use the blockwise
+    dense online-softmax body.
   - `ulysses_attention`: `all_to_all` re-shards sequence->heads, runs
     dense local attention per head group, and re-shards back — cheaper
     for many-head models when heads % devices == 0.
@@ -62,6 +65,56 @@ def _block_attend(q, k, v, acc, m, l, mask=None, scale=1.0):
     return acc_new, m_new, l_new
 
 
+def _ring_attention_flash_shard(q, k, v, axis_name, causal, scale, force,
+                                platform):
+    """Ring body where each (Q, K/V-block) pair runs the Pallas flash
+    kernel (fwd AND bwd — O(s_loc) memory, no (s_loc, s_loc) scores) and
+    per-block (out, lse) pairs merge with the standard logsumexp
+    combine. Block causality: the resident diagonal pair is causal; a
+    block from a lower rank attends fully; higher ranks contribute
+    nothing (lse=-inf)."""
+    from ..ops.attention import flash_attention_with_lse
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def attend(k_blk, v_blk, blk_causal):
+        return flash_attention_with_lse(q, k_blk, v_blk, causal=blk_causal,
+                                        scale=scale, force=force,
+                                        platform=platform)
+
+    # the ring is UNROLLED in python (n is the static mesh-axis size):
+    # straight-line per-step kernel calls lower cleanly under shard_map
+    # (interpret-mode pallas inside lax loops trips an MLIR lowering-
+    # cache bug in this jax), and causal skipping needs no lax.cond —
+    # a skipped block is simply merged with lse=-inf (weight zero),
+    # the same every-block-computed masking the dense body uses
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    lse = jnp.full_like(q[..., 0], -jnp.inf, dtype=jnp.float32)
+    k_blk, v_blk = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        if causal and step == 0:
+            # resident diagonal pair: causal within the block
+            o_i, lse_i = attend(k_blk, v_blk, True)
+        else:
+            o_i, lse_i = attend(k_blk, v_blk, False)
+            if causal:
+                src = (rank - step) % n          # owner of this K/V
+                lse_i = jnp.where(src < rank, lse_i, -jnp.inf)
+        # logsumexp merge of the block's normalized output
+        lse_new = jnp.logaddexp(lse, lse_i)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        o = o * jnp.exp(lse - safe)[..., None] \
+            + o_i.astype(jnp.float32) * jnp.exp(lse_i - safe)[..., None]
+        lse = lse_new
+        if step < n - 1:
+            k_blk, v_blk = (jax.lax.ppermute(x, axis_name, perm)
+                            for x in (k_blk, v_blk))
+    return o.astype(q.dtype)
+
+
 def _ring_attention_shard(q, k, v, axis_name, causal, scale):
     """Per-device body under shard_map: Q stays, K/V rotate the ring."""
     n = jax.lax.psum(1, axis_name)
@@ -108,24 +161,48 @@ def _ring_attention_shard(q, k, v, axis_name, causal, scale):
     return (acc / l[..., None]).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
+                   impl=None):
     """Sequence-sharded attention over `mesh[axis_name]`.
 
     q/k/v: (B, H, S, D) with S divisible by the axis size; returns the
     attention output with the same sharding. Context length scales
-    linearly with devices; peak memory per device is O(S_local^2) scores
-    per block pair instead of O(S^2).
+    linearly with devices.
+
+    impl: None (auto) | 'dense' | 'flash'.
+      - 'flash' (auto-picked on TPU meshes): each (Q, K/V-block) pair
+        runs the Pallas flash kernels fwd+bwd and per-block (out, lse)
+        merge with logsumexp — peak per-device memory O(S_local), never
+        an (S_local, S_local) score tile in HBM. Ineligible shapes (and
+        CPU meshes) fall back to the dense-with-lse oracle per block
+        automatically, so 'flash' is safe everywhere; the Pallas kernels
+        themselves engage only on TPU devices. (No interpret mode here:
+        interpret-Pallas inside shard_map trips jax-internal vma checks
+        in this build — kernel-level coverage lives in
+        tests/test_attention.py and tests_tpu.)
     """
     nsp = mesh.shape[axis_name]
     if q.shape[2] % nsp != 0:
         raise MXNetError(
             f"ring_attention: sequence {q.shape[2]} not divisible by "
             f"{axis_name}={nsp}")
+    if impl is None:
+        impl = "flash" if mesh.devices.flat[0].platform not in ("cpu",) \
+            else "dense"
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_shard, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    if impl == "dense":
+        body = functools.partial(_ring_attention_shard,
+                                 axis_name=axis_name, causal=causal,
+                                 scale=scale)
+    elif impl == "flash":
+        body = functools.partial(
+            _ring_attention_flash_shard, axis_name=axis_name,
+            causal=causal, scale=scale, force=None,
+            platform=mesh.devices.flat[0].platform)
+    else:
+        raise MXNetError(f"ring_attention: unknown impl {impl!r}")
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     return fn(q, k, v)
 
 

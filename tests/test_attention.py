@@ -112,3 +112,29 @@ def test_flash_backward_single_block():
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
                                    atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_with_lse_grads_include_lse_cotangent(causal):
+    """flash_attention_with_lse is differentiable in BOTH outputs: the
+    kernels fold the lse cotangent into the backward row term (glse).
+    Oracle: autodiff through the dense (out, lse) formulation. The loss
+    mixes out and lse so a dropped/miswired glse fails loudly."""
+    q, k, v = _qkv(b=1, h=2, s=256, d=128, seed=21)
+
+    def loss_flash(q, k, v):
+        out, lse = at.flash_attention_with_lse(q, k, v, causal=causal,
+                                               force="interpret")
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_dense(q, k, v):
+        out, lse = at.reference_attention_with_lse(q, k, v, causal=causal)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    with jax.default_matmul_precision("highest"):
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{name}")
