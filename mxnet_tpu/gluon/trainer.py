@@ -24,7 +24,130 @@ from .. import optimizer as opt
 from ..model import _create_kvstore
 from .parameter import ParameterDict, Parameter
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "fused_fit"]
+
+
+def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
+              optimizer_params=None, steps_per_dispatch=8, contexts=None,
+              dtype="float32", epoch_callback=None):
+    """K-steps-per-dispatch training driver for gluon nets
+    (steps_per_dispatch, beyond-reference; Module.fit's equivalent knob).
+
+    Traces `net` + `loss` (both HybridBlocks) into one symbol, compiles a
+    fused fwd+bwd+update step over the contexts' mesh, and dispatches K
+    consecutive steps per jitted lax.scan call — amortizing per-step host
+    dispatch, the dominant cost for small-step models on a remote-tunnel
+    TPU (docs/ROUND4.md: 4x on the LSTM LM lane). The update math is the
+    fused-op twin of the imperative Trainer loop on the same batches.
+
+    `net` must be initialized (params created; a deferred-init net is
+    finished against the first batch). `train_data` yields (data, label)
+    pairs — a gluon DataLoader — with fixed shapes; a short tail block
+    compiles its own k'-step scan (cached). Trained params are written
+    back into `net` after the final epoch and at every epoch boundary, so
+    `epoch_callback(epoch, net, mean_loss)` and ordinary gluon
+    save/export see current values. Returns the per-epoch mean losses.
+
+    Constraints (use the imperative Trainer loop where they bind): the
+    optimizer must have a fused update op (parallel.dp._OPT_OPS), and the
+    training metric is the loss itself — per-batch prediction metrics
+    need Module.fit(steps_per_dispatch=K)'s outputs_mode="all" path.
+    """
+    import itertools
+    import numpy as np
+    from .. import symbol as sym_mod
+    from ..context import current_context
+    from ..ndarray.ndarray import NDArray, array as nd_array
+    from ..parallel.dp import DataParallelTrainer
+    from ..parallel.mesh import mesh_for_contexts
+
+    contexts = contexts or [current_context()]
+    if not isinstance(contexts, (list, tuple)):
+        contexts = [contexts]
+
+    it = iter(train_data)
+    try:
+        first = next(it)
+    except StopIteration:
+        raise MXNetError("fused_fit: train_data is empty")
+    x0, y0 = first[0], first[1]
+    if not isinstance(x0, NDArray):
+        x0, y0 = nd_array(np.asarray(x0)), nd_array(np.asarray(y0))
+    # finish deferred init (shapes come from the first batch) before the
+    # symbolic trace reads param shapes
+    net(x0)
+
+    data_v = sym_mod.Variable("data")
+    label_v = sym_mod.Variable("fused_label")
+    out_sym = net(data_v)
+    if isinstance(out_sym, (list, tuple)):
+        out_sym = out_sym[0]
+    loss_sym = loss(out_sym, label_v)
+    if isinstance(loss_sym, (list, tuple)):
+        loss_sym = loss_sym[0]
+
+    batch = int(x0.shape[0])
+    opt_params = dict(optimizer_params or {})
+    lr = float(opt_params.pop("learning_rate", 0.01))
+    trainer = DataParallelTrainer(
+        loss_sym, mesh_for_contexts(list(contexts)), data_names=("data",),
+        label_names=("fused_label",), optimizer=optimizer,
+        learning_rate=lr, momentum=float(opt_params.pop("momentum", 0.0)),
+        wd=float(opt_params.pop("wd", 0.0)),
+        rescale_grad=float(opt_params.pop("rescale_grad", 1.0 / batch)),
+        clip_gradient=opt_params.pop("clip_gradient", None), dtype=dtype,
+        **opt_params)
+    pmap = {p.name: p for _, p in net.collect_params().items()}
+    params, states, aux = trainer.init_state(
+        {"data": tuple(x0.shape), "fused_label": tuple(y0.shape)},
+        arg_params={n: pmap[n].data() for n in trainer.param_names},
+        aux_params={n: pmap[n].data() for n in trainer.aux_names
+                    if n in pmap})
+
+    def _np_of(a):
+        return np.asarray(getattr(a, "_data", a))
+
+    def _writeback():
+        # COPY out of the training state: step_k donates its params/states
+        # buffers, so binding the live arrays into the net would leave the
+        # net (and any epoch_callback snapshot) holding deleted buffers
+        # after the next epoch's first dispatch
+        for n, p in zip(trainer.param_names, params):
+            pmap[n].set_data(nd_array(np.asarray(p)))
+        for n, a in zip(trainer.aux_names, aux):
+            if n in pmap:
+                pmap[n].set_data(nd_array(np.asarray(a)))
+
+    k = int(steps_per_dispatch)
+    epoch_losses = []
+    for epoch in range(num_epoch):
+        total, count = 0.0, 0
+        stream = itertools.chain([first], it) if epoch == 0 \
+            else iter(train_data)
+        while True:
+            block = list(itertools.islice(stream, k))
+            if not block:
+                break
+            xs = np.stack([_np_of(b[0]) for b in block])
+            ys = np.stack([_np_of(b[1]) for b in block])
+            inputs = trainer.shard_inputs([xs, ys], stacked=True)
+            params, states, aux, losses, _ = trainer.step_k(
+                params, states, aux, inputs)
+            total += float(np.sum(np.asarray(losses)))
+            count += len(block) * batch
+        if count == 0:
+            # a single-pass generator exhausts after epoch 0 — failing
+            # loudly beats recording 0.0-loss "epochs" that trained nothing
+            raise MXNetError(
+                f"fused_fit: epoch {epoch} yielded no batches (is "
+                "train_data a single-pass generator? pass a re-iterable "
+                "like a DataLoader or list)")
+        mean_loss = total / max(count, 1)
+        epoch_losses.append(mean_loss)
+        _writeback()
+        if epoch_callback is not None:
+            epoch_callback(epoch, net, mean_loss)
+    return epoch_losses
 
 
 class Trainer:

@@ -15,7 +15,7 @@ from .ndarray import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["save_checkpoint", "load_checkpoint", "load_params",
-           "BatchEndParam"]
+           "BatchEndParam", "FeedForward"]
 
 import collections
 
@@ -112,6 +112,176 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
         name = param_names[index]
         kvstore.push(name, grad_list, priority=-index)
         kvstore.pull(name, arg_list, priority=-index)
+
+
+class FeedForward:
+    """Legacy model API (deprecated upstream; kept for parity).
+
+    Thin shim over mod.Module — parity target python/mxnet/model.py:390-994
+    (FeedForward.__init__ :390, fit :744, predict :599, score :660,
+    save :905, load :929, create :953). The reference deprecates it in
+    favor of Module; this shim preserves the numpy-in/numpy-out surface
+    (X/y arrays are wrapped into NDArrayIter the way the reference's
+    _init_iter :514 does) while delegating all execution to Module.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn(
+            "FeedForward is deprecated (as in the reference). "
+            "Please use Module instead.", DeprecationWarning, stacklevel=2)
+        from .context import Context, current_context
+        from .initializer import Uniform
+        if ctx is None:
+            ctx = [current_context()]
+        elif isinstance(ctx, Context):
+            ctx = [ctx]
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        # remaining kwargs are optimizer hyperparams (reference :445)
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _init_iter(self, X, y, is_train):
+        """numpy (X, y) -> NDArrayIter (reference _init_iter :514)."""
+        import numpy as np
+        from . import io as io_mod
+        if hasattr(X, "provide_data"):   # already a DataIter
+            return X
+        X = np.asarray(X)
+        if y is None:
+            if is_train:
+                raise ValueError("y is required for training")
+            y = np.zeros(X.shape[0], dtype=np.float32)
+        y = np.asarray(y)
+        batch = min(self.numpy_batch_size, X.shape[0])
+        return io_mod.NDArrayIter(X, y.astype(np.float32),
+                                  batch_size=batch, shuffle=is_train,
+                                  label_name="softmax_label")
+
+    def _make_module(self, data_iter):
+        from .module.module import Module
+        labels = [n for n, _ in (data_iter.provide_label or [])]
+        mod = Module(self.symbol, label_names=labels or None,
+                     context=self.ctx)
+        return mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Train (reference :744): wraps Module.fit over the same data."""
+        assert self.num_epoch is not None, "num_epoch must be set"
+        train_iter = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._init_iter(eval_data[0], eval_data[1],
+                                        is_train=False)
+        self._module = self._make_module(train_iter)
+        if logger is not None:
+            self._module.logger = logger
+        opt_params = dict(self.kwargs)
+        self._module.fit(
+            train_iter, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=opt_params,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def _bound_for_eval(self, data_iter):
+        mod = self._make_module(data_iter)
+        mod.bind(data_shapes=data_iter.provide_data,
+                 label_shapes=data_iter.provide_label, for_training=False)
+        mod.set_params(self.arg_params or {}, self.aux_params or {},
+                       allow_missing=False)
+        return mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Predict -> numpy (reference :599)."""
+        import numpy as np
+        data_iter = self._init_iter(X, None, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_for_eval(data_iter)
+        outs = mod.predict(data_iter, num_batch=num_batch, reset=False,
+                           always_output_list=True)
+        outs_np = [o.asnumpy() for o in outs]
+        result = outs_np[0] if len(outs_np) == 1 else outs_np
+        if return_data:
+            data_iter.reset()
+            xs, ys = [], []
+            for b in data_iter:
+                keep = b.data[0].shape[0] - b.pad
+                xs.append(b.data[0].asnumpy()[:keep])
+                ys.append(b.label[0].asnumpy()[:keep])
+            return result, np.concatenate(xs), np.concatenate(ys)
+        return result
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Evaluate a metric over X (reference :660)."""
+        data_iter = self._init_iter(X, y, is_train=False)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_for_eval(data_iter)
+        res = mod.score(data_iter, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback, reset=False)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        """save_checkpoint under the legacy naming (reference :905)."""
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Load a checkpointed FeedForward (reference :929)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Construct + fit in one call (reference :953)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,

@@ -157,9 +157,39 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The full training loop (base_module.py:395)."""
+            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1):
+        """The full training loop (base_module.py:395).
+
+        `steps_per_dispatch=K` (K>1, beyond-reference) runs K consecutive
+        training steps inside ONE compiled dispatch (a jitted lax.scan over
+        the fused fwd+bwd+update step — DataParallelTrainer.step_k), which
+        amortizes per-step host dispatch. Semantics under K>1: the update
+        math is bit-compatible with K=1 per-batch stepping (same batches,
+        same order, same fused updates), but the training metric is updated
+        once per K-block (over all K batches' outputs at once) and
+        batch_end_callbacks fire once per K-block with `nbatch` advanced by
+        K. Requires a fused-op optimizer (sgd/adam/...; see
+        parallel.dp._OPT_OPS), a non-distributed kvstore, and no
+        monitor/state/fixed-param features; anything else falls back to
+        K=1 with a warning."""
         assert num_epoch is not None, "please specify number of epochs"
+        if steps_per_dispatch and steps_per_dispatch > 1:
+            handled = self._fit_fused(
+                train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer, optimizer_params=optimizer_params,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_rebind=force_rebind, force_init=force_init,
+                begin_epoch=begin_epoch, num_epoch=num_epoch,
+                validation_metric=validation_metric, monitor=monitor,
+                sparse_row_id_fn=sparse_row_id_fn,
+                steps_per_dispatch=int(steps_per_dispatch))
+            if handled:
+                return
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -236,6 +266,15 @@ class BaseModule:
                                      name, val)
 
             train_data.reset()
+
+    def _fit_fused(self, train_data, **kwargs):
+        """steps_per_dispatch>1 hook. Subclasses that can fuse K steps into
+        one dispatch (Module) override this; returning False falls back to
+        the per-batch loop."""
+        logging.warning(
+            "%s does not support steps_per_dispatch>1; falling back to "
+            "per-batch dispatch", type(self).__name__)
+        return False
 
     # -- symbol/params interface (implemented by subclasses) -----------------
 

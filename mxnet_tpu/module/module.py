@@ -319,6 +319,188 @@ class Module(BaseModule):
                 self.params_initialized = True
                 self._sync_params_from_devices()
 
+    # -- fused multi-step fit (steps_per_dispatch > 1) -----------------------
+    def _fit_fused(self, train_data, eval_data, eval_metric,
+                   epoch_end_callback, batch_end_callback, kvstore,
+                   optimizer, optimizer_params, eval_end_callback,
+                   eval_batch_end_callback, initializer, arg_params,
+                   aux_params, allow_missing, force_rebind, force_init,
+                   begin_epoch, num_epoch, validation_metric, monitor,
+                   sparse_row_id_fn, steps_per_dispatch):
+        """K-steps-per-dispatch training loop (see BaseModule.fit docs).
+
+        The per-batch executor+updater machinery is replaced for the epoch
+        loop by a DataParallelTrainer whose step_k runs K fused
+        fwd+bwd+update steps in one jitted lax.scan dispatch; params/aux
+        are seeded from this module's normally-initialized values and
+        written back at every epoch boundary, so checkpoints, epoch
+        callbacks, and validation scoring see exactly what K=1 would.
+        Returns False (with a warning) when the config can't fuse —
+        BaseModule.fit then runs the per-batch path."""
+        import time
+        import itertools
+        import numpy as np
+        from ..parallel.dp import DataParallelTrainer, _OPT_OPS
+        from ..parallel.mesh import mesh_for_contexts
+        from ..ndarray.ndarray import NDArray
+        from .base_module import _as_list
+        from .. import metric as metric_mod
+        from ..model import BatchEndParam
+
+        opt_params = dict(optimizer_params or {})
+        blockers = []
+        if not (isinstance(optimizer, str) and optimizer in _OPT_OPS):
+            blockers.append(f"optimizer {optimizer!r} has no fused update "
+                            f"op (supported: {sorted(_OPT_OPS)})")
+        if not (kvstore is None or (isinstance(kvstore, str) and
+                                    "dist" not in kvstore)):
+            blockers.append(f"kvstore {kvstore!r} is distributed/custom")
+        if "lr_scheduler" in opt_params:
+            blockers.append("lr_scheduler (drive set_learning_rate "
+                            "externally instead)")
+        if monitor is not None:
+            blockers.append("monitor")
+        if self._state_names:
+            blockers.append("state_names")
+        if self._fixed_param_names:
+            blockers.append("fixed_param_names")
+        if self._group2ctxs:
+            blockers.append("group2ctxs")
+        if not blockers and isinstance(optimizer, str) \
+                and optimizer in _OPT_OPS:
+            # hyperparams the fused update op's schema can't take (e.g.
+            # multi_precision, lazy_update) must fall back, not raise
+            from ..ops.registry import get_op
+            op_entry = _OPT_OPS[optimizer]
+            opname = op_entry({"momentum": opt_params.get("momentum")}) \
+                if callable(op_entry) else op_entry
+            handled = {"learning_rate", "momentum", "wd", "rescale_grad",
+                       "clip_gradient"}
+            extra = [k for k in opt_params
+                     if k not in handled and k not in get_op(opname).params]
+            if extra:
+                blockers.append(
+                    f"optimizer_params {extra} not supported by the fused "
+                    f"{opname} op")
+        if blockers:
+            self.logger.warning(
+                "steps_per_dispatch>1 unsupported for this config (%s); "
+                "falling back to per-batch dispatch", "; ".join(blockers))
+            return False
+
+        k = steps_per_dispatch
+        # normal bind + init so the parameter draw is identical to K=1
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        batch_callbacks = _as_list(batch_end_callback)
+        epoch_callbacks = _as_list(epoch_end_callback)
+
+        batch_size = self._data_shapes[0].shape[0]
+        lr = float(opt_params.pop("learning_rate", 0.01))
+        trainer = DataParallelTrainer(
+            self._symbol, mesh_for_contexts(self._context),
+            data_names=tuple(self._data_names),
+            label_names=tuple(self._label_names), optimizer=optimizer,
+            learning_rate=lr,
+            momentum=float(opt_params.pop("momentum", 0.0)),
+            wd=float(opt_params.pop("wd", 0.0)),
+            rescale_grad=float(opt_params.pop("rescale_grad",
+                                              1.0 / batch_size)),
+            clip_gradient=opt_params.pop("clip_gradient", None),
+            **opt_params)
+        shape_kwargs = {d.name: d.shape for d in
+                        self._data_shapes + (self._label_shapes or [])}
+        params, states, aux = trainer.init_state(
+            shape_kwargs, arg_params=self._arg_params,
+            aux_params=self._aux_params)
+
+        data_idx = {n: i for i, n in enumerate(self._data_names)}
+        label_idx = {n: i for i, n in enumerate(self._label_names)}
+
+        def _np_of(a):
+            return np.asarray(getattr(a, "_data", a))
+
+        for epoch in range(begin_epoch, num_epoch):
+            epoch_start = time.time()
+            eval_metric.reset()
+            data_iter = iter(train_data)
+            nbatch = 0
+            while True:
+                block = list(itertools.islice(data_iter, k))
+                if not block:
+                    break
+                # a short tail block compiles its own (cached) k'-step scan
+                stacked = []
+                for name in trainer.input_names:
+                    if name in data_idx:
+                        col = [_np_of(b.data[data_idx[name]])
+                               for b in block]
+                    else:
+                        col = [_np_of(b.label[label_idx[name]])
+                               for b in block]
+                    stacked.append(np.stack(col))
+                inputs = trainer.shard_inputs(stacked, stacked=True)
+                params, states, aux, losses, outputs = trainer.step_k(
+                    params, states, aux, inputs, outputs_mode="all")
+                # metric over ALL K batches at once: flatten the scan axis
+                # into the batch axis (same samples K=1 would feed one by
+                # one, one update call instead of K)
+                pred_dict = {
+                    name: NDArray(o.reshape((-1,) + o.shape[2:]))
+                    for name, o in zip(self._output_names, outputs)}
+                label_dict = {
+                    name: NDArray(
+                        np.concatenate(
+                            [_np_of(b.label[i]) for b in block]))
+                    for name, i in label_idx.items()}
+                eval_metric.update_dict(label_dict, pred_dict)
+                nbatch += len(block)
+                if batch_callbacks:
+                    cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
+                                             eval_metric=eval_metric,
+                                             locals=locals())
+                    for callback in batch_callbacks:
+                        callback(cb_param)
+
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - epoch_start)
+
+            # write the device-carried state back so checkpoints/callbacks/
+            # validation see the trained params exactly as K=1 would.
+            # COPIES (np.asarray), not the live buffers: step_k donates its
+            # params, so aliasing them into the executor would leave it
+            # holding deleted arrays after the next epoch's first dispatch
+            self.set_params(
+                {n: NDArray(np.asarray(p)) for n, p in
+                 zip(trainer.param_names, params)},
+                {n: NDArray(np.asarray(a))
+                 for n, a in zip(trainer.aux_names, aux)})
+            snapshot_args, snapshot_aux = self.get_params()
+            for callback in epoch_callbacks:
+                callback(epoch, self.symbol, snapshot_args, snapshot_aux)
+
+            if eval_data is not None:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+            train_data.reset()
+        return True
+
     # -- optimizer -----------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

@@ -131,9 +131,33 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
                              dtype=_np.int64)
         dense = _np.zeros(shape, dtype=data.dtype if dtype is None else dtype)
         rows = _np.repeat(_np.arange(shape[0]), _np.diff(indptr))
+        if len(indices) and (int(indices.min()) < 0
+                             or int(indices.max()) >= shape[1]):
+            # validate BEFORE the flat dedup key: a negative index would
+            # wrap into a positive cell there instead of erroring
+            raise MXNetError(
+                f"csr_matrix: column index out of range [0, {shape[1]}) "
+                f"(min {int(indices.min())}, max {int(indices.max())})")
+        key = rows * shape[1] + indices
+        uniq, inv = _np.unique(key, return_inverse=True)
+        if len(uniq) != len(key):
+            # duplicate (row, col) entries: canonicalize by SUMMING them —
+            # into the dense backing AND the ELL components — so the
+            # gather fast path (which sums every entry) and the dense
+            # fallback/tostype('default') agree. Plain dense[r, c] = data
+            # would silently keep last-write-wins in one view only.
+            summed = _np.zeros(len(uniq), dtype=data.dtype)
+            _np.add.at(summed, inv, data)
+            data = summed
+            rows = (uniq // shape[1]).astype(_np.int64)
+            indices = (uniq % shape[1]).astype(_np.int64)
+            indptr = _np.concatenate(
+                [[0], _np.cumsum(_np.bincount(rows, minlength=shape[0]))]
+            ).astype(_np.int64)
         dense[rows, indices] = data
         nd = array(dense, ctx=ctx, dtype=dtype)
-        val, idx, counts = sp.ell_from_csr(data, indices, indptr)
+        val, idx, counts = sp.ell_from_csr(data, indices, indptr,
+                                           num_features=shape[1])
         # components carry the SAME dtype as the dense backing, or the
         # fast paths would compute at a different precision
         ell = (array(val, ctx=ctx, dtype=dtype)._data,

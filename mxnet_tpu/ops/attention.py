@@ -379,10 +379,13 @@ def _pallas_eligible(q, k, platform=None):
         return False
     if s < 8:
         return False
+    # TPU-only auto-pick: the kernels' lse layout and block tiling are
+    # TPU-tuned — a GPU backend falls back to the XLA path unless the
+    # caller forces pallas explicitly
     if platform is not None:
-        return platform not in ("cpu",)
+        return platform == "tpu"
     try:
-        return jax.default_backend() not in ("cpu",)
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
 

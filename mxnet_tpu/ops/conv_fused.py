@@ -42,7 +42,7 @@ import jax.numpy as jnp
 _BLOCK_P = 512          # lanes per grid cell (multiple of 128)
 
 
-def _pick_block_p(p, ci, co):
+def _pick_block_p(p, ci, co, has_residual=False):
     """Lane-block size. ResNet spatial dims (56^2=3136, 28^2, ...) are
     not 128-divisible, so fall back to a full-P block (legal via the
     equal-dimension escape) when the whole (Ci+Co, P) working set fits
@@ -51,16 +51,19 @@ def _pick_block_p(p, ci, co):
         for b in (_BLOCK_P, 256, 128):
             if p % b == 0:
                 return b
-    # full-P block: bf16 in+out tiles + fp32 accumulator
+    # full-P block: bf16 in+out tiles + fp32 accumulator, plus the
+    # optional residual input tile (another Ci x P in bf16)
     vmem = (ci * p + co * p) * 2 + co * p * 4
+    if has_residual:
+        vmem += ci * p * 2
     return p if vmem <= 8 * 1024 * 1024 else None
 
 
-def eligible(ci, co, p):
+def eligible(ci, co, p, has_residual=False):
     """Shapes the megakernel path accepts: both channel dims tile the
     8x128 register grid and the spatial dim blocks into lanes."""
     return (ci % 8 == 0 and co % 8 == 0 and
-            _pick_block_p(p, ci, co) is not None)
+            _pick_block_p(p, ci, co, has_residual) is not None)
 
 
 def _c1x1_kernel(x_ref, w_ref, scale_ref, shift_ref, res_ref,
@@ -114,7 +117,7 @@ def conv1x1(x, w, *, bn_in=None, residual=None, relu_in=False,
 
     n, ci, p = x.shape
     co = w.shape[0]
-    bp = _pick_block_p(p, ci, co)
+    bp = _pick_block_p(p, ci, co, has_residual=residual is not None)
     if bp is None:
         raise ValueError(f"spatial dim {p} not blockable")
     prologue = bn_in is not None

@@ -20,16 +20,26 @@ import jax
 import jax.numpy as jnp
 
 
-def ell_from_csr(data, indices, indptr, pad_to_multiple=8):
+def ell_from_csr(data, indices, indptr, pad_to_multiple=8,
+                 num_features=None):
     """Host-side CSR -> ELL conversion, vectorized (no per-row python
     loop — construction must scale to million-row matrices). Returns
     (val (R, K), idx (R, K), counts (R,)) with K = max row nnz rounded
     up for lane friendliness; counts preserves the exact nnz structure
     (pad entries are indistinguishable from an explicit zero at column
-    0 without it)."""
+    0 without it). `num_features` (when known) bounds-checks the column
+    indices here on the host — the device gathers/scatters downstream
+    CLIP out-of-range indices instead of erroring, which would turn a
+    malformed triplet into silently wrong values."""
     data = _np.asarray(data)
     indices = _np.asarray(indices, dtype=_np.int32)
     indptr = _np.asarray(indptr, dtype=_np.int64)
+    if len(indices) and (int(indices.min()) < 0 or (
+            num_features is not None
+            and int(indices.max()) >= num_features)):
+        raise ValueError(
+            f"ell_from_csr: column index out of range [0, {num_features}) "
+            f"(got min {int(indices.min())}, max {int(indices.max())})")
     rows = len(indptr) - 1
     counts = _np.diff(indptr).astype(_np.int32)
     k = int(counts.max()) if rows else 0
@@ -48,6 +58,10 @@ def ell_from_csr(data, indices, indptr, pad_to_multiple=8):
 def ell_dot(val, idx, weight):
     """dot(csr, dense): out[r] = sum_j val[r,j] * weight[idx[r,j]].
     Padded entries contribute val=0. out (R, M)."""
+    if isinstance(idx, _np.ndarray) and idx.size and \
+            int(idx.max()) >= weight.shape[0]:
+        raise ValueError(f"ell_dot: column index {int(idx.max())} out of "
+                         f"range for weight rows {weight.shape[0]}")
     gathered = jnp.take(weight, idx, axis=0)          # (R, K, M)
     return jnp.einsum("rk,rkm->rm", val.astype(weight.dtype), gathered)
 
@@ -56,6 +70,10 @@ def ell_dot_t(val, idx, dense, num_features):
     """dot(csr.T, dense): out[f] += sum over (r,j) with idx[r,j]==f of
     val[r,j] * dense[r]. The backward/transpose pattern (dW of a linear
     layer over sparse inputs). out (F, M) via XLA scatter-add."""
+    if isinstance(idx, _np.ndarray) and idx.size and \
+            int(idx.max()) >= num_features:
+        raise ValueError(f"ell_dot_t: column index {int(idx.max())} out of "
+                         f"range for num_features {num_features}")
     r, k = val.shape
     m = dense.shape[1]
     contrib = (val.astype(dense.dtype)[..., None]

@@ -168,12 +168,59 @@ class DataParallelTrainer:
 
         repl = NamedSharding(mesh, P())
         shard = NamedSharding(mesh, P(self._data_axis))
+        # stacked (K, batch, ...) blocks for step_k: scan axis replicated,
+        # batch axis (axis 1) sharded over the mesh
+        self._block_shard = NamedSharding(mesh, P(None, self._data_axis))
         self._repl, self._shard = repl, shard
+        self._step_py = step
+        self._multi = {}   # (k, outputs_mode) -> jitted K-step scan
         self._step = jax.jit(
             step,
             in_shardings=(repl, repl, repl, shard, repl, repl, repl),
             out_shardings=(repl, repl, repl, repl, shard, repl, repl),
             donate_argnums=(0, 1))
+
+    def _multi_step_fn(self, k, outputs_mode):
+        """K training steps fused into ONE compiled dispatch (a lax.scan
+        over the single-step body). This is the op-bulking concern of the
+        reference engine (graph_executor.cc:1343-1369) applied at step
+        granularity: through a remote PJRT tunnel each python dispatch
+        costs ~1-8 ms, so amortizing it over K steps is worth up to 4x on
+        small-step models (measured on the LSTM LM lane, docs/ROUND4.md).
+        rng and the step counter are carried on-device across the scan, so
+        K fused steps are bit-identical to K python-dispatched steps."""
+        key = (int(k), outputs_mode)
+        fn = self._multi.get(key)
+        if fn is not None:
+            return fn
+        step = self._step_py
+
+        def multi(params, states, aux, inputs, rng, lr, t):
+            def body(carry, xs):
+                params, states, aux, rng, t = carry
+                params, states, aux, loss, outputs, rng, t = step(
+                    params, states, aux, xs, rng, lr, t)
+                ys = (loss, outputs) if outputs_mode == "all" else loss
+                return (params, states, aux, rng, t), ys
+
+            (params, states, aux, rng, t), ys = jax.lax.scan(
+                body, (params, states, aux, rng, t), inputs, length=key[0])
+            if outputs_mode == "all":
+                losses, outputs = ys
+            else:
+                losses, outputs = ys, ()
+            return params, states, aux, losses, outputs, rng, t
+
+        repl, block = self._repl, self._block_shard
+        fn = jax.jit(
+            multi,
+            in_shardings=(repl, repl, repl, block, repl, repl, repl),
+            out_shardings=(repl, repl, repl, repl,
+                           block if outputs_mode == "all" else repl,
+                           repl, repl),
+            donate_argnums=(0, 1))
+        self._multi[key] = fn
+        return fn
 
     @property
     def param_names(self):
@@ -183,18 +230,28 @@ class DataParallelTrainer:
     def input_names(self):
         return list(self._input_names)
 
-    def init_state(self, shape_kwargs, initializer=None, seed=0):
+    @property
+    def aux_names(self):
+        return list(self._aux_names)
+
+    def init_state(self, shape_kwargs, initializer=None, seed=0,
+                   arg_params=None, aux_params=None):
         """Infer shapes from input shapes; return (params, states, aux)
         tuples of replicated jax arrays. `states` holds one tuple of
         optimizer-state arrays per parameter (momenta for sgd, mean/var for
-        adam, ...)."""
+        adam, ...). `arg_params`/`aux_params` (name -> NDArray/array)
+        seed values directly — Module's fused fit hands over the params it
+        already initialized so both fit paths start from the same draw."""
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
         shapes = dict(zip(self._arg_names, arg_shapes))
         rng = _np.random.RandomState(seed)
         params = []
         for n in self._param_names:
             s = shapes[n]
-            if initializer is not None:
+            if arg_params is not None and n in arg_params:
+                a = arg_params[n]
+                v = _np.asarray(getattr(a, "_data", a), _np.float32)
+            elif initializer is not None:
                 from ..ndarray.ndarray import zeros as nd_zeros
                 arr = nd_zeros(s)
                 from ..initializer import InitDesc
@@ -209,26 +266,34 @@ class DataParallelTrainer:
                   for _ in range(self._n_states))
             for p in params)
         aux = tuple(jax.device_put(
+            _np.asarray(getattr(aux_params[n], "_data", aux_params[n]),
+                        _np.float32)
+            if aux_params is not None and n in aux_params
             # moving/running variances start at 1 (MXNet BatchNorm parity)
-            _np.ones(s, _np.float32)
+            else _np.ones(s, _np.float32)
             if n.endswith(("moving_var", "running_var"))
             else _np.zeros(s, _np.float32), self._repl)
             for n, s in zip(self._aux_names, aux_shapes))
         return tuple(params), states, aux
 
-    def shard_inputs(self, arrays):
-        """Commit host batch arrays to the mesh, sharded on axis 0.
+    def shard_inputs(self, arrays, stacked=False):
+        """Commit host batch arrays to the mesh, sharded on the batch axis.
+
+        `stacked=False`: per-step (batch, ...) arrays, sharded on axis 0.
+        `stacked=True`: (K, batch, ...) blocks for step_k — the scan axis
+        stays replicated and axis 1 (batch) is sharded.
 
         Host numpy goes straight to the mesh sharding — never through
         `jnp.asarray`, which would commit to the *default* device first
         (wrong platform when the mesh is not on the default backend).
         """
+        sharding = self._block_shard if stacked else self._shard
         out = []
         for a in arrays:
             a = getattr(a, "_data", a)
             if not isinstance(a, jax.Array):
                 a = _np.asarray(a)
-            out.append(jax.device_put(a, self._shard))
+            out.append(jax.device_put(a, sharding))
         return tuple(out)
 
     @property
@@ -266,5 +331,37 @@ class DataParallelTrainer:
                          self._lr_dev, self._t_dev)
         # rng/t are device-carried (split/incremented inside the step): the
         # host never dispatches per-step key splits or scalar transfers
+        self._rng_dev, self._t_dev = out[5], out[6]
+        return out[:5]
+
+    def step_k(self, params, states, aux, inputs, rng=None,
+               outputs_mode="none"):
+        """Run K fused training steps in ONE dispatch (steps_per_dispatch).
+
+        `inputs` are (K, batch, ...) stacked blocks (shard_inputs with
+        stacked=True); K is read off the leading axis and each distinct K
+        compiles once (cached). Returns (params, states, aux, losses,
+        outputs) where `losses` has shape (K,). `outputs_mode`:
+          - "none" (default): outputs is () — nothing beyond the losses
+            leaves the scan (an LSTM LM's stacked logits would be GBs).
+          - "all": outputs are the symbol outputs of EVERY step, stacked
+            on a leading K axis (Module's fused fit uses this to feed the
+            training metric).
+        Bit-identical to K step() calls from the same rng key: the scan
+        body IS the single-step body and the key chain is the same splits.
+        """
+        if rng is not None:
+            self._rng_dev = jax.device_put(rng, self._repl)
+        elif self._rng_dev is None:
+            from .. import random as _random
+            self._rng_dev = jax.device_put(_random.next_key(), self._repl)
+        if self._lr_dev is None:
+            self._lr_dev = jax.device_put(_np.float32(self._lr), self._repl)
+        if self._t_dev is None:
+            self._t_dev = jax.device_put(_np.float32(self._t), self._repl)
+        k = int(inputs[0].shape[0])
+        fn = self._multi_step_fn(k, outputs_mode)
+        out = fn(params, states, aux, inputs, self._rng_dev, self._lr_dev,
+                 self._t_dev)
         self._rng_dev, self._t_dev = out[5], out[6]
         return out[:5]

@@ -187,7 +187,10 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
             f"ring_attention: sequence {q.shape[2]} not divisible by "
             f"{axis_name}={nsp}")
     if impl is None:
-        impl = "flash" if mesh.devices.flat[0].platform not in ("cpu",) \
+        # the flash kernels are TPU-tuned (8-lane lse layout, TPU block
+        # tiling): auto-pick them only on a TPU mesh — any other non-CPU
+        # platform (gpu) gets the dense body rather than untested kernels
+        impl = "flash" if mesh.devices.flat[0].platform == "tpu" \
             else "dense"
     spec = P(None, None, axis_name, None)
     if impl == "dense":

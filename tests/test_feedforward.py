@@ -1,0 +1,70 @@
+"""FeedForward legacy-API shim tests (reference python/mxnet/model.py:390-994;
+reference tests: tests/python/unittest/test_model_parallel / legacy users)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    a1 = mx.sym.Activation(f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, name="fc2", num_hidden=3)
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _toy(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.uniform(-2, 2, size=(3, 8)).astype(np.float32)
+    y = rng.randint(0, 3, size=n)
+    x = centers[y] + rng.normal(0, 0.3, (n, 8)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    x, y = _toy()
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward(
+            _mlp(), ctx=mx.cpu(0), num_epoch=8, numpy_batch_size=32,
+            learning_rate=0.2, momentum=0.9,
+            initializer=mx.init.Xavier())
+    model.fit(x, y)
+    # numpy-in / numpy-out predict
+    probs = model.predict(x)
+    assert probs.shape == (len(x), 3)
+    acc = (probs.argmax(1) == y).mean()
+    assert acc > 0.9, acc
+    assert model.score(x, y) > 0.9
+
+    # save/load round-trip under the legacy checkpoint naming
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    with pytest.warns(DeprecationWarning):
+        loaded = mx.model.FeedForward.load(prefix, 8, ctx=mx.cpu(0))
+    probs2 = loaded.predict(x)
+    np.testing.assert_allclose(probs, probs2, rtol=1e-5)
+
+
+def test_feedforward_create_with_iter():
+    x, y = _toy(128, seed=1)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward.create(
+            _mlp(), it, ctx=mx.cpu(0), num_epoch=4, learning_rate=0.2,
+            initializer=mx.init.Xavier())
+    assert model.arg_params and "fc1_weight" in model.arg_params
+    probs = model.predict(x)
+    assert probs.shape == (128, 3)
+
+
+def test_feedforward_predict_return_data():
+    x, y = _toy(64, seed=2)
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(0), num_epoch=1,
+                                     numpy_batch_size=32, learning_rate=0.1)
+    model.fit(x, y)
+    probs, xs, ys = model.predict(x, return_data=True)
+    assert xs.shape == x.shape and ys.shape == y.shape
+    assert probs.shape == (64, 3)

@@ -109,3 +109,19 @@ def test_rank_and_type():
     assert kv.rank == 0
     assert kv.num_workers == 1
     assert kv.type == "device"
+
+
+def test_async_sync_fallback_warns(caplog):
+    """dist_async is accepted but RUNS SYNCHRONOUSLY by documented stance
+    (docs/PARITY.md kvstore row; reference async server applies pushes
+    immediately, kvstore_dist_server.h:437). The divergence must stay
+    visible: the warning is part of the contract, this test pins it."""
+    import logging
+    with caplog.at_level(logging.WARNING):
+        kv = mx.kvstore.create("dist_async")
+    assert any("running synchronously" in r.message for r in caplog.records)
+    # and it still behaves as a working (sync) store
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(SHAPE))

@@ -228,3 +228,29 @@ def test_where_tile_repeat():
     assert np.allclose(nd.where(cond, x, y).asnumpy(), [1.0, 4.0])
     assert nd.tile(x, reps=(2, 2)).shape == (2, 4)
     assert np.allclose(nd.repeat(x, repeats=2).asnumpy(), [1, 1, 2, 2])
+
+
+def test_csr_duplicate_entries_canonicalized():
+    """Duplicate (row, col) CSR entries are summed into BOTH the dense
+    backing and the ELL components (ADVICE r4: the views must agree)."""
+    import numpy as np
+    from mxnet_tpu.ndarray import sparse
+    a = sparse.csr_matrix(([1.0, 2.0, 5.0], [1, 1, 3], [0, 2, 3]),
+                          shape=(2, 4))
+    dense = a.tostype("default").asnumpy()
+    np.testing.assert_allclose(dense, [[0, 3, 0, 0], [0, 0, 0, 5]])
+    # gather fast path sees the same values
+    w = np.eye(4, dtype=np.float32)
+    from mxnet_tpu.ops import sparse_ops as sp
+    out = np.asarray(sp.ell_dot(a._ell[0], a._ell[1], w))
+    np.testing.assert_allclose(out, dense)
+
+
+def test_csr_out_of_range_index_errors():
+    import pytest as _pytest
+    from mxnet_tpu.ndarray import sparse
+    with _pytest.raises(Exception, match="out of range"):
+        sparse.csr_matrix(([1.0, 2.0, 3.0], [0, 0, -1], [0, 2, 3]),
+                          shape=(2, 4))
+    with _pytest.raises(Exception, match="out of range"):
+        sparse.csr_matrix(([1.0], [7], [0, 1]), shape=(1, 4))
