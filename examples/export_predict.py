@@ -1,0 +1,62 @@
+"""Train -> export -> standalone predict (the c_predict deployment flow).
+
+Role of the reference's image-classification predict examples +
+amalgamation deployment (include/mxnet/c_predict_api.h): train a small
+convnet, export the compiled inference program + params to ONE .mxa
+artifact, then serve it through mxnet_tpu.predictor — a self-contained
+module a deployment host can use without the training stack (copy
+mxnet_tpu/predictor.py next to the artifact and `import predictor`).
+
+Run: python examples/export_predict.py
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.export import export_model
+from mxnet_tpu.predictor import Predictor
+
+
+def main():
+    # -- a quick model on sklearn's digits --------------------------------
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = (d.data.astype(np.float32) / 16.0).reshape(-1, 1, 8, 8)
+    y = d.target.astype(np.float32)
+    xt, yt, xv, yv = x[:1500], y[:1500], x[1500:], y[1500:]
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(xt, yt, batch_size=50, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.current_context())
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), steps_per_dispatch=4)
+
+    # -- export: ONE artifact, shapes bound like MXPredCreate -------------
+    args, auxs = mod.get_params()
+    batch = 25
+    path = "digits.mxa"
+    export_model(path, sym, args, auxs, {"data": (batch, 1, 8, 8)})
+    print(f"exported {path}")
+
+    # -- standalone predict (no Module/Symbol/Executor involved) ----------
+    pred = Predictor(path)
+    print("inputs :", pred.input_info)
+    print("outputs:", pred.output_shapes)
+    correct = total = 0
+    for i in range(0, len(xv) - batch + 1, batch):
+        probs = pred.forward(xv[i:i + batch])[0]
+        correct += int((probs.argmax(1) == yv[i:i + batch]).sum())
+        total += batch
+    print(f"standalone predictor accuracy: {correct / total:.4f}")
+    assert correct / total > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -7,3 +7,5 @@ from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import torch_bridge  # noqa: F401
 from . import onnx  # noqa: F401
+from . import export  # noqa: F401
+from .export import export_model  # noqa: F401

@@ -209,11 +209,25 @@ class FeedForward:
         return self
 
     def _bound_for_eval(self, data_iter):
+        # cached across predict/score calls (the reference keeps one
+        # _pred_exec, model.py:477): rebinding each call would recompile
+        # the identical inference program every time
+        key = (tuple(map(tuple, data_iter.provide_data)),
+               tuple(map(tuple, data_iter.provide_label or [])))
+        cached = getattr(self, "_eval_cache", None)
+        if cached is not None and cached[0] == key:
+            mod = cached[1]
+            # refresh params (cheap device_put, no recompile): fit() or
+            # the user may have replaced arg_params since the last call
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
+            return mod
         mod = self._make_module(data_iter)
         mod.bind(data_shapes=data_iter.provide_data,
                  label_shapes=data_iter.provide_label, for_training=False)
         mod.set_params(self.arg_params or {}, self.aux_params or {},
                        allow_missing=False)
+        self._eval_cache = (key, mod)
         return mod
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

@@ -1,0 +1,238 @@
+"""Reference NDArray binary container — byte-compatible save/load.
+
+This is the wire format every reference checkpoint (`.params` file) uses,
+re-implemented from the C++ serializers so checkpoints round-trip between
+this framework and the reference:
+
+- file framing  kMXAPINDArrayListMagic = 0x112 (ndarray.cc:1785-1808):
+  uint64 header, uint64 reserved, dmlc vector<NDArray>, vector<string>
+  (dmlc serializer framing: uint64 count; strings as uint64 len + bytes)
+- per-array    NDARRAY_V2_MAGIC = 0xF993fac9 (ndarray.cc:1582-1650):
+  uint32 magic, int32 stype, [storage TShape if sparse], TShape shape,
+  Context {int32 dev_type, int32 dev_id} (base.h:188-201), int32
+  type_flag, [per-aux int32 type + TShape], raw values, [raw aux arrays]
+- TShape       uint32 ndim + int64 dims (nnvm Tuple<dim_t>::Save; the
+  legacy pre-V1 uint32-dims form is handled on load, ndarray.cc:1655-1668
+  LegacyTShapeLoad)
+- legacy V1    0xF993fac8: no stype, TShape::Load (ndarray.cc:1671)
+- pre-V1       magic IS ndim, dims are uint32 (ndarray.cc:1661-1667)
+
+Sparse arrays follow the reference aux layout (ndarray.h storage types:
+0 default, 1 row_sparse, 2 csr): row_sparse stores values (storage shape
+(stored_rows, row...)) + one int64 idx aux; csr stores values ((nnz,)) +
+int64 indptr and idx auxes — ndarray.cc:1597-1650.
+
+Loading tolerates trailing garbage-free legacy files only; everything is
+little-endian (the reference never wrote big-endian hosts' files
+portably; x86 LE is the de-facto format).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+LIST_MAGIC = 0x112
+V2_MAGIC = 0xF993FAC9
+V1_MAGIC = 0xF993FAC8
+
+# mshadow type flags (mshadow/base.h kFloat32..kInt64)
+_FLAG_TO_DTYPE = {0: _np.float32, 1: _np.float64, 2: _np.float16,
+                  3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64}
+_DTYPE_TO_FLAG = {_np.dtype(v): k for k, v in _FLAG_TO_DTYPE.items()}
+
+
+def _dtype_of(flag):
+    try:
+        return _FLAG_TO_DTYPE[flag]
+    except KeyError:
+        # newer-reference dtypes (int16=8, bfloat16=12, ...): fail with
+        # the flag, not a misleading truncated-file/garbage-values read
+        raise MXNetError(f"load: unsupported dtype flag {flag}") from None
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    out.append(_np.asarray(shape, dtype="<i8").tobytes())
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n):
+        if self.pos + n > len(self.buf):
+            raise MXNetError("NDArray container: truncated file")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def shape(self):
+        ndim = self.u32()
+        return tuple(_np.frombuffer(self.read(8 * ndim), "<i8").tolist())
+
+    def legacy_shape(self, ndim):
+        return tuple(_np.frombuffer(self.read(4 * ndim), "<u4").tolist())
+
+    def array(self, dtype, shape):
+        n = int(_np.prod(shape, dtype=_np.int64)) if shape else 1
+        a = _np.frombuffer(self.read(n * _np.dtype(dtype).itemsize),
+                           _np.dtype(dtype).newbyteorder("<"))
+        return a.reshape(shape).astype(dtype, copy=False)
+
+
+def _np_of(a):
+    if isinstance(a, _np.ndarray):
+        return _np.ascontiguousarray(a)
+    return _np.ascontiguousarray(_np.asarray(getattr(a, "_data", a)))
+
+
+def _flag_of(arr, what):
+    flag = _DTYPE_TO_FLAG.get(arr.dtype)
+    if flag is None:
+        raise MXNetError(
+            f"save: dtype {arr.dtype} of {what} has no reference container "
+            f"type flag (supported: "
+            f"{sorted(str(_np.dtype(d)) for d in _DTYPE_TO_FLAG)}); cast "
+            "first — the reference container predates bfloat16")
+    return flag
+
+
+def _save_one(out, nd):
+    """One NDArray::Save blob (ndarray.cc:1588-1650)."""
+    stype = getattr(nd, "stype", "default")
+    out.append(struct.pack("<I", V2_MAGIC))
+    if stype == "default":
+        arr = _np_of(nd)
+        out.append(struct.pack("<i", 0))
+        _write_shape(out, arr.shape)
+        out.append(struct.pack("<ii", 1, 0))           # Context cpu(0)
+        out.append(struct.pack("<i", _flag_of(arr, "array")))
+        out.append(arr.astype(arr.dtype.newbyteorder("<")).tobytes())
+        return
+    if stype == "row_sparse":
+        idx = _np_of(nd.indices).astype("<i8")
+        val = _np_of(nd.data)
+        out.append(struct.pack("<i", 1))
+        _write_shape(out, val.shape)                   # storage shape
+        _write_shape(out, nd.shape)
+        out.append(struct.pack("<ii", 1, 0))
+        out.append(struct.pack("<i", _flag_of(val, "row_sparse values")))
+        out.append(struct.pack("<i", 6))               # aux: int64 idx
+        _write_shape(out, idx.shape)
+        out.append(val.astype(val.dtype.newbyteorder("<")).tobytes())
+        out.append(idx.tobytes())
+        return
+    if stype == "csr":
+        val = _np_of(nd.data)
+        indices = _np_of(nd.indices).astype("<i8")
+        indptr = _np_of(nd.indptr).astype("<i8")
+        out.append(struct.pack("<i", 2))
+        _write_shape(out, val.shape)                   # (nnz,)
+        _write_shape(out, nd.shape)
+        out.append(struct.pack("<ii", 1, 0))
+        out.append(struct.pack("<i", _flag_of(val, "csr values")))
+        out.append(struct.pack("<i", 6))               # aux 0: indptr
+        _write_shape(out, indptr.shape)
+        out.append(struct.pack("<i", 6))               # aux 1: idx
+        _write_shape(out, indices.shape)
+        out.append(val.astype(val.dtype.newbyteorder("<")).tobytes())
+        out.append(indptr.tobytes())
+        out.append(indices.tobytes())
+        return
+    raise MXNetError(f"save: unsupported storage type {stype!r}")
+
+
+def _load_one(r):
+    """One NDArray::Load (ndarray.cc:1700-1781 incl. legacy paths).
+    Returns a host construction recipe: ('dense', numpy) or
+    ('row_sparse'|'csr', components, shape)."""
+    magic = r.u32()
+    if magic == V2_MAGIC:
+        stype = r.i32()
+        nad = {0: 0, 1: 1, 2: 2}.get(stype)
+        if nad is None:
+            raise MXNetError(f"load: unknown storage type {stype}")
+        sshape = r.shape() if nad else None
+        shape = r.shape()
+        if not shape:
+            raise MXNetError("load: none (empty-shape) arrays unsupported")
+        r.i32(), r.i32()                               # Context: ignored
+        dtype = _dtype_of(r.i32())
+        aux = []
+        for _ in range(nad):
+            aux.append((_dtype_of(r.i32()), r.shape()))
+        values = r.array(dtype, sshape if nad else shape)
+        aux_arrays = [r.array(d, s) for d, s in aux]
+        if stype == 0:
+            return ("dense", values)
+        if stype == 1:
+            return ("row_sparse", (values, aux_arrays[0]), shape)
+        return ("csr", (values, aux_arrays[1], aux_arrays[0]), shape)
+    if magic == V1_MAGIC:
+        shape = r.shape()
+    else:
+        shape = r.legacy_shape(magic)                  # magic IS ndim
+    if not shape:
+        raise MXNetError("load: none (empty-shape) arrays unsupported")
+    r.i32(), r.i32()
+    return ("dense", r.array(_dtype_of(r.i32()), shape))
+
+
+def save_container(fname, data):
+    """Serialize {name: NDArray} / [NDArray] / NDArray to the reference
+    container (NDArray::Save list form, ndarray.cc:1787)."""
+    if hasattr(data, "keys"):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names, arrays = [], list(data)
+    else:
+        names, arrays = [], [data]
+    out = [struct.pack("<QQ", LIST_MAGIC, 0),
+           struct.pack("<Q", len(arrays))]
+    for nd in arrays:
+        _save_one(out, nd)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def is_container(head):
+    """Sniff the first 8 bytes for the list magic."""
+    return len(head) >= 8 and \
+        struct.unpack("<Q", head[:8])[0] == LIST_MAGIC
+
+
+def load_container(fname):
+    """Load a reference container -> list of recipes + names (see
+    _load_one); ndarray.load wraps them into NDArrays."""
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != LIST_MAGIC:
+        raise MXNetError(f"{fname}: not an NDArray container")
+    r.u64()                                            # reserved
+    items = [_load_one(r) for _ in range(r.u64())]
+    names = []
+    for _ in range(r.u64()):
+        names.append(r.read(r.u64()).decode("utf-8"))
+    if names and len(names) != len(items):
+        raise MXNetError(f"{fname}: {len(items)} arrays but {len(names)} "
+                         "names")
+    return items, names

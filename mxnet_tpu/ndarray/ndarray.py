@@ -600,26 +600,43 @@ def imdecode(*a, **kw):
     raise MXNetError("imdecode: use mxnet_tpu.image")
 
 
-# -- serialization (role of NDArray::Save/Load, src/ndarray/ndarray.cc:1582;
-#    container format replaced by npz — TPU build has no C ABI consumers) ----
+# -- serialization (role of NDArray::Save/Load, src/ndarray/ndarray.cc:1582).
+#    Files are written in the REFERENCE's binary container format (magic
+#    0x112 / 0xF993fac9, ndarray/container.py) so checkpoints round-trip
+#    with reference-era tooling; load() additionally sniffs and accepts the
+#    npz files rounds 1-4 of this repo wrote. -------------------------------
 
 def save(fname, data):
-    if isinstance(data, NDArray):
-        _np.savez(fname, __order__=_np.array([], dtype=_np.str_),
-                  **{"__single__": data.asnumpy()})
-    elif isinstance(data, (list, tuple)):
-        _np.savez(fname, __order__=_np.array([], dtype=_np.str_),
-                  **{f"__list__{i}": d.asnumpy() for i, d in enumerate(data)})
-    elif isinstance(data, dict):
-        _np.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
-    else:
+    from . import container
+    if not isinstance(data, (NDArray, list, tuple)) and \
+            not isinstance(data, dict):
         raise TypeError("save: data must be NDArray, list, or dict")
-    import os
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    if isinstance(data, (list, tuple)) and \
+            not all(isinstance(d, NDArray) for d in data):
+        raise TypeError("save: list elements must be NDArrays")
+    container.save_container(fname, data)
 
 
 def load(fname):
+    from . import container
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if container.is_container(head):
+        items, names = container.load_container(fname)
+        out = []
+        for kind, payload, *rest in items:
+            if kind == "dense":
+                out.append(array(payload))
+            elif kind == "row_sparse":
+                from .sparse import row_sparse_array
+                out.append(row_sparse_array(payload, shape=rest[0]))
+            else:
+                from .sparse import csr_matrix
+                out.append(csr_matrix(payload, shape=rest[0]))
+        if names:
+            return dict(zip(names, out))
+        return out
+    # npz fallback: the r1-r4 checkpoint format of this repo
     with _np.load(fname, allow_pickle=False) as z:
         keys = [k for k in z.files if k != "__order__"]
         if keys == ["__single__"]:

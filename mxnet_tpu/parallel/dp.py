@@ -180,7 +180,7 @@ class DataParallelTrainer:
             out_shardings=(repl, repl, repl, repl, shard, repl, repl),
             donate_argnums=(0, 1))
 
-    def _multi_step_fn(self, k, outputs_mode):
+    def _multi_step_fn(self, k, outputs_mode, unroll=False):
         """K training steps fused into ONE compiled dispatch (a lax.scan
         over the single-step body). This is the op-bulking concern of the
         reference engine (graph_executor.cc:1343-1369) applied at step
@@ -189,7 +189,10 @@ class DataParallelTrainer:
         small-step models (measured on the LSTM LM lane, docs/ROUND4.md).
         rng and the step counter are carried on-device across the scan, so
         K fused steps are bit-identical to K python-dispatched steps."""
-        key = (int(k), outputs_mode)
+        # True==1 as a dict key but lax.scan treats them differently
+        # (True = full unroll, 1 = rolled): normalize True to "full"
+        key = (int(k), outputs_mode,
+               "full" if unroll is True else max(1, int(unroll)))
         fn = self._multi.get(key)
         if fn is not None:
             return fn
@@ -204,7 +207,8 @@ class DataParallelTrainer:
                 return (params, states, aux, rng, t), ys
 
             (params, states, aux, rng, t), ys = jax.lax.scan(
-                body, (params, states, aux, rng, t), inputs, length=key[0])
+                body, (params, states, aux, rng, t), inputs, length=key[0],
+                unroll=True if key[2] == "full" else key[2])
             if outputs_mode == "all":
                 losses, outputs = ys
             else:
@@ -335,7 +339,7 @@ class DataParallelTrainer:
         return out[:5]
 
     def step_k(self, params, states, aux, inputs, rng=None,
-               outputs_mode="none"):
+               outputs_mode="none", unroll=False):
         """Run K fused training steps in ONE dispatch (steps_per_dispatch).
 
         `inputs` are (K, batch, ...) stacked blocks (shard_inputs with
@@ -349,6 +353,13 @@ class DataParallelTrainer:
             training metric).
         Bit-identical to K step() calls from the same rng key: the scan
         body IS the single-step body and the key chain is the same splits.
+
+        `unroll=True` unrolls the K-step scan into straight-line code:
+        K x compile time, but programs whose step itself contains
+        lax.while/scan loops (RNNs) avoid the nested-loop overhead XLA
+        adds around inner loops (measured on v5e: the LSTM LM step's
+        inner whiles run 3x slower under an outer rolled scan; unrolled
+        they run at single-step device speed).
         """
         if rng is not None:
             self._rng_dev = jax.device_put(rng, self._repl)
@@ -360,7 +371,7 @@ class DataParallelTrainer:
         if self._t_dev is None:
             self._t_dev = jax.device_put(_np.float32(self._t), self._repl)
         k = int(inputs[0].shape[0])
-        fn = self._multi_step_fn(k, outputs_mode)
+        fn = self._multi_step_fn(k, outputs_mode, unroll)
         out = fn(params, states, aux, inputs, self._rng_dev, self._lr_dev,
                  self._t_dev)
         self._rng_dev, self._t_dev = out[5], out[6]

@@ -1,0 +1,182 @@
+"""Standalone predictor for exported .mxa artifacts — the c_predict role.
+
+Deployment-side counterpart of contrib/export.py (reference:
+include/mxnet/c_predict_api.h:1-250 and the amalgamation/ single-file
+build). This file is deliberately SELF-CONTAINED: it imports only
+stdlib + numpy + jax — no mxnet_tpu modules — so it can be copied out of
+the package (the amalgamation role) and used on a host that has no
+operator library, no symbol machinery, no training stack. The embedded
+container reader below duplicates ndarray/container.py's dense path for
+exactly that reason.
+
+c_predict_api mapping:
+  MXPredCreate            -> Predictor(path)        (shapes bound at
+                             export time, as MXPredCreate binds them)
+  MXPredSetInput          -> forward(name=array, ...)
+  MXPredForward           -> forward(...)
+  MXPredGetOutputShape    -> .output_shapes
+  MXPredGetOutput         -> forward's return value
+  MXPredFree              -> garbage collection
+
+Run `python -m mxnet_tpu.predictor model.mxa input.npy` for a CLI
+smoke-check (prints output shapes and the argmax of output 0).
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zipfile
+
+import numpy as np
+
+_MANIFEST = "MANIFEST.json"
+_MODULE_FILE = "module.stablehlo"
+_PARAMS_FILE = "params.bin"
+
+# reference NDArray container constants (src/ndarray/ndarray.cc:1582-1808)
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_FLAG_TO_DTYPE = {0: np.float32, 1: np.float64, 2: np.float16,
+                  3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
+
+
+def _read_container_dense(buf):
+    """Minimal dense-only reader of the reference .params container."""
+    pos = 0
+
+    def take(n):
+        nonlocal pos
+        b = buf[pos:pos + n]
+        if len(b) != n:
+            raise ValueError("truncated container")
+        pos += n
+        return b
+
+    def u32():
+        return struct.unpack("<I", take(4))[0]
+
+    def i32():
+        return struct.unpack("<i", take(4))[0]
+
+    def u64():
+        return struct.unpack("<Q", take(8))[0]
+
+    def shape():
+        return tuple(np.frombuffer(take(8 * u32()), "<i8").tolist())
+
+    if u64() != _LIST_MAGIC:
+        raise ValueError("not an NDArray container")
+    u64()
+    arrays = []
+    for _ in range(u64()):
+        if u32() != _V2_MAGIC:
+            raise ValueError("predictor: only V2 dense blobs supported")
+        if i32() != 0:
+            raise ValueError("predictor: sparse params unsupported")
+        s = shape()
+        i32(), i32()
+        dt = np.dtype(_FLAG_TO_DTYPE[i32()])
+        n = int(np.prod(s, dtype=np.int64))
+        arrays.append(np.frombuffer(take(n * dt.itemsize),
+                                    dt.newbyteorder("<")).reshape(s))
+    names = [take(u64()).decode("utf-8") for _ in range(u64())]
+    return dict(zip(names, arrays))
+
+
+class Predictor:
+    """Load an exported artifact and serve fixed-shape inference."""
+
+    def __init__(self, path, device=None):
+        import jax
+        from jax import export as jexport
+        with zipfile.ZipFile(path) as zf:
+            self.manifest = json.loads(zf.read(_MANIFEST))
+            exp = jexport.deserialize(zf.read(_MODULE_FILE))
+            params = _read_container_dense(zf.read(_PARAMS_FILE))
+        if self.manifest.get("format_version") != 1:
+            raise ValueError(
+                f"unsupported artifact version "
+                f"{self.manifest.get('format_version')}")
+        self._exp = exp
+        self._input_names = [i["name"] for i in self.manifest["inputs"]]
+        self._input_shapes = {i["name"]: tuple(i["shape"])
+                              for i in self.manifest["inputs"]}
+        dev = device or jax.devices()[0]
+        self._state = [
+            jax.device_put(params[f"arg:{n}"], dev)
+            for n in self.manifest["param_names"]]
+        self._state += [
+            jax.device_put(params[f"aux:{n}"], dev)
+            for n in self.manifest["aux_names"]]
+        self._rng = jax.device_put(np.zeros(2, np.uint32), dev)
+        self._dev = dev
+
+    @property
+    def input_info(self):
+        return list(self.manifest["inputs"])
+
+    @property
+    def output_names(self):
+        return list(self.manifest["outputs"])
+
+    @property
+    def output_shapes(self):
+        outs = self._exp.out_avals[:]
+        return [(n, tuple(o.shape))
+                for n, o in zip(self.manifest["outputs"], outs)]
+
+    def forward(self, *args, **kwargs):
+        """Run inference. Inputs positionally (manifest order) or by
+        name; returns a list of numpy arrays (one per output)."""
+        import jax
+        if args and kwargs:
+            raise ValueError("pass inputs positionally or by name, "
+                             "not both")
+        if kwargs:
+            try:
+                args = [kwargs.pop(n) for n in self._input_names]
+            except KeyError as e:
+                raise ValueError(f"missing input {e.args[0]!r}; expects "
+                                 f"{self._input_names}")
+            if kwargs:
+                raise ValueError(f"unknown inputs {sorted(kwargs)}; "
+                                 f"expects {self._input_names}")
+        if len(args) != len(self._input_names):
+            raise ValueError(f"expected {len(self._input_names)} inputs "
+                             f"{self._input_names}, got {len(args)}")
+        feed = []
+        for n, a in zip(self._input_names, args):
+            a = np.asarray(getattr(a, "_data", a), dtype=np.float32) \
+                if not isinstance(a, np.ndarray) else a
+            if tuple(a.shape) != self._input_shapes[n]:
+                raise ValueError(
+                    f"input {n!r}: shape {tuple(a.shape)} does not match "
+                    f"the exported shape {self._input_shapes[n]} (shapes "
+                    "are bound at export time, as in MXPredCreate)")
+            feed.append(jax.device_put(np.asarray(a, np.float32),
+                                       self._dev))
+        outs = self._exp.call(*feed, *self._state, self._rng)
+        return [np.asarray(o) for o in outs]
+
+
+def main(argv=None):
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m mxnet_tpu.predictor model.mxa "
+              "[input.npy ...]")
+        return 1
+    pred = Predictor(argv[0])
+    print("inputs :", pred.input_info)
+    print("outputs:", pred.output_shapes)
+    if len(argv) > 1:
+        feeds = [np.load(p) for p in argv[1:]]
+        outs = pred.forward(*feeds)
+        for name, o in zip(pred.output_names, outs):
+            print(f"{name}: shape {o.shape} argmax "
+                  f"{np.asarray(o).reshape(o.shape[0], -1).argmax(-1)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

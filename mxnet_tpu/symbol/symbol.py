@@ -459,8 +459,141 @@ def Group(symbols):
     return Symbol(outs)
 
 
+# hidden node attrs the reference's C API strips/renames on save+load
+# (c_api_symbolic.cc:40-42 kHiddenKeys)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+_CURRENT_REF_VERSION = 10100    # the reference fork is MXNet ~1.1.0
+
+
+def _upgrade_legacy_json(data):
+    """Upgrade reference-era symbol JSON in place so old model files keep
+    loading (role of src/nnvm/legacy_json_util.cc:1-228 + the kHiddenKeys
+    handling in c_api_symbolic.cc). Files written by THIS repo
+    (mxnet_tpu_version graph attr) pass through untouched. Applied
+    passes, mirroring the reference's upgrader_list (:187-193):
+
+    - FixParsing (any reference version): raw hidden keys on op nodes
+      become `__key__` user attrs; `{arg}_{key}` forms move onto the
+      matching input variable (legacy_json_util.cc:49-110)
+    - 0.8->0.9: aux variables were not stored — append the missing input
+      variables, named `{node_name}_{arg_name}`
+      (legacy_json_util.cc:134-151)
+    - 0.9.4->0.9.5: argmin/argmax axis=-1 meant "flatten" — drop the
+      attr to recover the default (legacy_json_util.cc:173-184)
+    """
+    import logging
+    graph_attrs = data.get("attrs", {})
+    if "mxnet_tpu_version" in graph_attrs:
+        return data
+    ver = graph_attrs.get("mxnet_version")
+    if isinstance(ver, (list, tuple)):     # nnvm graph-attr form ["int", N]
+        ver = ver[-1]
+    # aux-in-json arrived in 0.9.0 (the reference assumes 0.8.0 when the
+    # version attr is absent, legacy_json_util.cc:198)
+    ver = int(ver) if ver is not None else 800
+    if ver > _CURRENT_REF_VERSION:
+        logging.info(
+            "Warning: loading symbol saved by MXNet version %d with this "
+            "framework's reference parity at v%d. May cause undefined "
+            "behavior.", ver, _CURRENT_REF_VERSION)
+    elif ver < _CURRENT_REF_VERSION:
+        logging.info(
+            "Loading symbol saved by previous version v%d.%d.%d. "
+            "Attempting to upgrade...", ver // 10000, (ver // 100) % 100,
+            ver % 100)
+
+    nodes = data["nodes"]
+    arg_nodes = set(data.get("arg_nodes", ()))
+
+    def _attrs(entry):
+        return entry.setdefault("attrs", entry.pop("param", None) or {})
+
+    # -- FixParsing: hidden keys --------------------------------------------
+    for entry in nodes:
+        attrs = _attrs(entry)
+        if entry["op"] == "null":
+            for key in _HIDDEN_KEYS:
+                if key in attrs:
+                    attrs[f"__{key}__"] = attrs.pop(key)
+            continue
+        try:
+            in_names = get_op(entry["op"]).input_names
+        except MXNetError:
+            in_names = []
+        for k in list(attrs):
+            for key in _HIDDEN_KEYS:
+                if k == key:
+                    attrs[f"__{key}__"] = attrs.pop(k)
+                    break
+                if k.endswith("_" + key):
+                    arg = k[:-(len(key) + 1)]
+                    if arg in in_names:
+                        idx = in_names.index(arg)
+                        if idx < len(entry["inputs"]):
+                            tgt = nodes[entry["inputs"][idx][0]]
+                            if tgt["op"] == "null":
+                                _attrs(tgt)[f"__{key}__"] = attrs.pop(k)
+                    break
+
+    # -- 0.8 -> 0.9: materialize missing aux-variable inputs ----------------
+    if ver < 900:
+        # new variables must precede their consumer (the node list is
+        # topo-ordered), so rebuild the list with an index remap
+        pending = {}        # consumer old-id -> [new var entries]
+        n_new = 0
+        for j, entry in enumerate(nodes):
+            if entry["op"] == "null":
+                continue
+            try:
+                schema = get_op(entry["op"])
+            except MXNetError:
+                continue
+            in_names = schema.input_names
+            missing = range(len(entry["inputs"]), len(in_names))
+            # ONLY aux states were unstored pre-0.9; a short input list
+            # from an optional input (no_bias FullyConnected) must NOT
+            # grow a phantom bias variable
+            if not missing or not all(i in schema.aux_indices
+                                      for i in missing):
+                continue
+            for i in missing:
+                name = f"{entry['name']}_{in_names[i]}" \
+                    if entry["name"] else in_names[i]
+                var = {"op": "null", "name": name, "inputs": []}
+                pending.setdefault(j, []).append(var)
+                n_new += 1
+                entry["inputs"].append([("new", id(var)), 0, 0])
+        if n_new:
+            new_nodes, remap = [], {}
+            for j, entry in enumerate(nodes):
+                for var in pending.get(j, ()):
+                    remap[("new", id(var))] = len(new_nodes)
+                    new_nodes.append(var)
+                remap[j] = len(new_nodes)
+                new_nodes.append(entry)
+            for entry in new_nodes:
+                entry["inputs"] = [[remap[i], k, *rest] for (i, k, *rest)
+                                   in entry["inputs"]]
+            arg_nodes = {remap[i] for i in arg_nodes} | {
+                i for i, e in enumerate(new_nodes) if e["op"] == "null"}
+            data["heads"] = [[remap[i], k, *rest] for (i, k, *rest)
+                             in data.get("heads", [])]
+            data["nodes"] = nodes = new_nodes
+
+    # -- 0.9.4 -> 0.9.5: argmin/argmax axis flag change ---------------------
+    if ver < 905:
+        for entry in nodes:
+            if entry["op"] in ("argmin", "argmax") and \
+                    _attrs(entry).get("axis") == "-1":
+                del entry["attrs"]["axis"]
+
+    data["arg_nodes"] = sorted(arg_nodes)
+    return data
+
+
 def load_json(json_str):
-    data = json.loads(json_str)
+    data = _upgrade_legacy_json(json.loads(json_str))
     nodes = []
     for entry in data["nodes"]:
         attrs = dict(entry.get("attrs", entry.get("param", {})))
