@@ -78,7 +78,8 @@ def _bind(path):
     lib.mxio_pipe_create.restype = ctypes.c_void_p
     lib.mxio_pipe_create.argtypes = [
         ctypes.c_char_p, P_L, P_L, L, L, L, L, L, L,
-        ctypes.c_int, ctypes.c_int, P_F, P_F, L, L, L, ctypes.c_uint64]
+        ctypes.c_int, ctypes.c_int, P_F, P_F, L, L, L, ctypes.c_uint64,
+        ctypes.c_int]
     lib.mxio_pipe_reset.restype = ctypes.c_int
     lib.mxio_pipe_reset.argtypes = [ctypes.c_void_p, P_L, L]
     lib.mxio_pipe_next.restype = ctypes.c_int
@@ -247,7 +248,8 @@ class NativeImagePipe:
 
     def __init__(self, rec_path, offsets, lengths, batch, data_shape,
                  resize=0, rand_crop=False, rand_mirror=False, mean=None,
-                 std=None, label_width=1, nthreads=4, depth=0, seed=0):
+                 std=None, label_width=1, nthreads=4, depth=0, seed=0,
+                 out_dtype="float32"):
         L = lib()
         if L is None or not L.mxio_has_jpeg():
             raise MXNetNativeUnavailable("native JPEG pipeline unavailable")
@@ -256,6 +258,14 @@ class NativeImagePipe:
         self._batch = int(batch)
         self._shape = (int(c), int(h), int(w))
         self._label_width = int(label_width)
+        if out_dtype not in ("float32", "uint8"):
+            raise ValueError("out_dtype must be float32 or uint8")
+        if out_dtype == "uint8" and (mean is not None or std is not None):
+            # uint8 mode ships RAW bytes (4x less host->device traffic);
+            # normalization belongs on-device then
+            raise ValueError("uint8 output excludes host-side mean/std — "
+                             "normalize on device instead")
+        self._u8 = out_dtype == "uint8"
         offsets = _np.ascontiguousarray(offsets, _np.int64)
         lengths = _np.ascontiguousarray(lengths, _np.int64)
         P_L = ctypes.POINTER(ctypes.c_long)
@@ -289,7 +299,8 @@ class NativeImagePipe:
             # buffer-pool depth: each buffer is a full f32 batch (38MB at
             # batch 64 / 224^2), so default to the reference's
             # prefetch_buffer=4 rather than scaling with threads
-            int(depth) or min(4, max(2, int(nthreads))), int(seed))
+            int(depth) or min(4, max(2, int(nthreads))), int(seed),
+            int(self._u8))
         if not self._handle:
             raise MXNetNativeUnavailable("mxio_pipe_create failed")
 
@@ -305,7 +316,8 @@ class NativeImagePipe:
         """(data[b,c,h,w] f32, label[b,label_width] f32, pad) or None at
         epoch end. Raises IOError on decode/read errors."""
         c, h, w = self._shape
-        data = _np.empty((self._batch, c, h, w), _np.float32)
+        data = _np.empty((self._batch, c, h, w),
+                         _np.uint8 if self._u8 else _np.float32)
         label = _np.empty((self._batch, self._label_width), _np.float32)
         pad = ctypes.c_long()
         rc = self._lib.mxio_pipe_next(

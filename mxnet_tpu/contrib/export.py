@@ -117,14 +117,25 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     aux_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in aux_vals]
     rng_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)   # raw PRNG key
 
+    explicit = platforms is not None
     platforms = tuple(platforms or ("cpu", "tpu"))
     try:
         exp = jexport.export(jax.jit(fn), platforms=platforms)(
             *in_specs, *par_specs, *aux_specs, rng_spec)
-    except Exception:
-        # single-platform fallback (some backends reject multi-platform
-        # lowering); the artifact then records its platform list
+    except Exception as e:
+        if explicit:
+            # the caller asked for these platforms — failing loudly beats
+            # shipping an artifact that deploys on the wrong backend
+            raise
+        # default-platform-list fallback only: an op with no lowering for
+        # one of the default targets narrows the artifact to the current
+        # backend, WITH the reason on record
+        import logging
         platforms = (jax.default_backend(),)
+        logging.warning(
+            "export_model: multi-platform lowering %s failed (%s: %s); "
+            "exporting for %s only — pass platforms=... to control this",
+            ("cpu", "tpu"), type(e).__name__, e, platforms)
         exp = jexport.export(jax.jit(fn), platforms=platforms)(
             *in_specs, *par_specs, *aux_specs, rng_spec)
 
@@ -144,21 +155,13 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     }
     with tempfile.TemporaryDirectory() as td:
         pfile = os.path.join(td, PARAMS_FILE)
-        save = {f"arg:{n}": _Plain(v) for n, v in
-                zip(param_names, param_vals)}
-        save.update({f"aux:{n}": _Plain(v) for n, v in
-                     zip(aux_names, aux_vals)})
+        # container.save_container takes raw numpy directly
+        save = {f"arg:{n}": v for n, v in zip(param_names, param_vals)}
+        save.update({f"aux:{n}": v
+                     for n, v in zip(aux_names, aux_vals)})
         container.save_container(pfile, save)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr(MANIFEST, json.dumps(manifest, indent=1))
             zf.writestr(MODULE_FILE, exp.serialize())
             zf.write(pfile, PARAMS_FILE)
     return path
-
-
-class _Plain:
-    """Minimal NDArray-shaped wrapper so container.save_container accepts
-    raw numpy values."""
-    def __init__(self, a):
-        self._data = a
-        self.stype = "default"

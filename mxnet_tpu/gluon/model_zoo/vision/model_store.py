@@ -136,6 +136,10 @@ def get_model_file(name, root=None):
             with zipfile.ZipFile(zip_file_path) as zf:
                 zf.extractall(root)
             os.remove(zip_file_path)
+            if not os.path.exists(file_path):
+                raise MXNetError(
+                    f"fetched zip did not contain {file_name}.params at "
+                    "its top level")
         # OSError covers the file:// mirror path (missing/unreadable zip),
         # BadZipFile a corrupt one — the operator must always get the
         # actionable message, not a raw traceback

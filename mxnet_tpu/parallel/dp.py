@@ -47,7 +47,7 @@ class DataParallelTrainer:
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
                  clip_gradient=None, loss_index=0, dtype="float32",
-                 **opt_kwargs):
+                 input_preproc=None, **opt_kwargs):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..ops.registry import get_op, AttrDict, OpCtx
 
@@ -116,6 +116,12 @@ class DataParallelTrainer:
         compute_bf16 = self._compute_bf16
         data_name_set = frozenset(data_names)
         cast_input = [arg_names[p] in data_name_set for p in input_pos]
+        # input_preproc(name, value) -> value runs INSIDE the compiled
+        # step, before any bf16 cast — the device-side half of the
+        # ship-uint8/normalize-on-chip input regime (pair with
+        # ImageRecordIter(output_dtype="uint8")); XLA fuses it into the
+        # first conv's input chain
+        preproc_names = [arg_names[p] for p in input_pos]
 
         def step(params, states, aux, inputs, rng, lr, t):
             # rng and t are device-carried: split/increment INSIDE the
@@ -130,7 +136,10 @@ class DataParallelTrainer:
                 for p, v in zip(param_pos, params):
                     args[p] = jnp.asarray(v, jnp.bfloat16) \
                         if compute_bf16 else v
-                for p, v, cast in zip(input_pos, inputs, cast_input):
+                for p, v, cast, nm in zip(input_pos, inputs, cast_input,
+                                          preproc_names):
+                    if input_preproc is not None:
+                        v = input_preproc(nm, v)
                     # only FLOAT inputs cast: integer data (embedding token
                     # ids) would be corrupted by bf16's 8-bit mantissa
                     args[p] = jnp.asarray(v, jnp.bfloat16) \

@@ -534,6 +534,12 @@ def _upgrade_legacy_json(data):
                             tgt = nodes[entry["inputs"][idx][0]]
                             if tgt["op"] == "null":
                                 _attrs(tgt)[f"__{key}__"] = attrs.pop(k)
+                    if k in attrs:
+                        # unrelocatable (aux input not yet materialized /
+                        # non-variable input): keep the data as a HIDDEN
+                        # attr — left raw it would reach parse_attrs and
+                        # fail the load as an unknown op param
+                        attrs[f"__{k}__"] = attrs.pop(k)
                     break
 
     # -- 0.8 -> 0.9: materialize missing aux-variable inputs ----------------

@@ -305,11 +305,16 @@ struct PipeConfig {
   std::vector<float> mean, stdinv;  // size C or empty
   long label_width;
   uint64_t seed;
+  // uint8 output mode: raw CHW bytes, no mean/std — 4x less data for the
+  // host->device transfer; normalization runs on-device (the TPU-native
+  // input regime: ship bytes, normalize in the compiled step)
+  int out_u8 = 0;
 };
 
 struct BatchBuf {
-  std::vector<float> data;    // batch*C*H*W
-  std::vector<float> label;   // batch*label_width
+  std::vector<float> data;        // batch*C*H*W (f32 mode)
+  std::vector<unsigned char> u8;  // batch*C*H*W (u8 mode)
+  std::vector<float> label;       // batch*label_width
   long pad = 0;
 };
 
@@ -351,12 +356,13 @@ struct Pipe {
 // Decode + augment one record payload into batch slot i. `raw`/`resized`
 // are per-worker scratch buffers reused across records. Returns 0/-1.
 int ProcessRecord(Pipe* p, const unsigned char* payload, long len,
-                  uint64_t rng_seed, float* data_slot, float* label_slot,
+                  uint64_t rng_seed, float* data_slot,
+                  unsigned char* u8_slot, float* label_slot,
                   std::vector<unsigned char>& raw,
                   std::vector<unsigned char>& resized) {
 #if !defined(MXIO_HAS_JPEG)
   (void)p; (void)payload; (void)len; (void)rng_seed; (void)data_slot;
-  (void)label_slot; (void)raw; (void)resized;
+  (void)u8_slot; (void)label_slot; (void)raw; (void)resized;
   return -1;
 #else
   const PipeConfig& c = p->cfg;
@@ -408,6 +414,22 @@ int ProcessRecord(Pipe* p, const unsigned char* payload, long len,
   const bool mirror = c.rand_mirror && (NextRand(&rs) & 1);
 
   const long plane = c.H * c.W;
+  if (c.out_u8) {
+    for (long ch = 0; ch < c.C; ++ch) {
+      unsigned char* out_plane = u8_slot + ch * plane;
+      for (long y = 0; y < c.H; ++y) {
+        const unsigned char* row = cur + ((y0 + y) * cw + x0) * 3;
+        unsigned char* orow = out_plane + y * c.W;
+        if (!mirror) {
+          for (long x = 0; x < c.W; ++x) orow[x] = row[x * 3 + ch];
+        } else {
+          for (long x = 0; x < c.W; ++x)
+            orow[x] = row[(c.W - 1 - x) * 3 + ch];
+        }
+      }
+    }
+    return 0;
+  }
   for (long ch = 0; ch < c.C; ++ch) {
     const float m = ch < static_cast<long>(c.mean.size()) ? c.mean[ch] : 0.0f;
     const float si = ch < static_cast<long>(c.stdinv.size())
@@ -494,10 +516,12 @@ void WorkerLoop(Pipe* p) {
             static_cast<uint64_t>(idx) + 1;
         uint64_t rs = rseed;
         NextRand(&rs);
-        rc = ProcessRecord(p, rec_buf.data(), ln, rs,
-                           buf->data.data() + i * slot_sz,
-                           buf->label.data() + i * c.label_width,
-                           raw_scratch, resized_scratch);
+        rc = ProcessRecord(
+            p, rec_buf.data(), ln, rs,
+            c.out_u8 ? nullptr : buf->data.data() + i * slot_sz,
+            c.out_u8 ? buf->u8.data() + i * slot_sz : nullptr,
+            buf->label.data() + i * c.label_width,
+            raw_scratch, resized_scratch);
       }
     } catch (...) {
       rc = -1;
@@ -553,7 +577,7 @@ void* mxio_pipe_create(const char* rec_path, const long* offsets,
                        long C, long H, long W, long resize_short,
                        int rand_crop, int rand_mirror, const float* mean,
                        const float* stdinv, long label_width, long nthreads,
-                       long depth, uint64_t seed) {
+                       long depth, uint64_t seed, int out_u8) {
 #if !defined(MXIO_HAS_JPEG)
   return nullptr;
 #endif
@@ -569,13 +593,16 @@ void* mxio_pipe_create(const char* rec_path, const long* offsets,
                            : std::vector<float>(),
                       stdinv ? std::vector<float>(stdinv, stdinv + C)
                              : std::vector<float>(),
-                      label_width, seed};
+                      label_width, seed, out_u8};
   p->offsets.assign(offsets, offsets + n_records);
   p->lengths.assign(lengths, lengths + n_records);
   if (depth < 2) depth = 2;
   for (long i = 0; i < depth; ++i) {
     BatchBuf* b = new BatchBuf();
-    b->data.resize(static_cast<size_t>(batch) * C * H * W);
+    if (out_u8)
+      b->u8.resize(static_cast<size_t>(batch) * C * H * W);
+    else
+      b->data.resize(static_cast<size_t>(batch) * C * H * W);
     b->label.resize(static_cast<size_t>(batch) * label_width);
     p->all_bufs.push_back(b);
     p->freelist.push_back(b);
@@ -635,7 +662,10 @@ int mxio_pipe_next(void* handle, float* data, float* label, long* pad) {
     buf = p->ready[p->next_deliver];
     p->ready.erase(p->next_deliver);
   }
-  std::memcpy(data, buf->data.data(), buf->data.size() * sizeof(float));
+  if (p->cfg.out_u8)
+    std::memcpy(data, buf->u8.data(), buf->u8.size());
+  else
+    std::memcpy(data, buf->data.data(), buf->data.size() * sizeof(float));
   std::memcpy(label, buf->label.data(), buf->label.size() * sizeof(float));
   if (pad) *pad = buf->pad;
   {

@@ -115,3 +115,22 @@ def test_v080_optional_inputs_not_phantomized():
     assert sym.list_arguments() == ["data", "fc_weight"]
     ex = sym.simple_bind(ctx=mx.cpu(0), data=(2, 3))
     assert ex.forward(data=mx.nd.ones((2, 3)))[0].shape == (2, 4)
+
+
+def test_unrelocatable_hidden_key_survives_as_hidden():
+    """A '{arg}_{key}' hidden attr whose target input isn't a loadable
+    variable (pre-0.9 aux not yet materialized) must become a __hidden__
+    attr, not crash parse_attrs as an unknown op param."""
+    js = _ref_json(
+        [{"op": "null", "name": "data", "inputs": []},
+         {"op": "null", "name": "bn_gamma", "inputs": []},
+         {"op": "null", "name": "bn_beta", "inputs": []},
+         {"op": "BatchNorm", "name": "bn",
+          "param": {"moving_mean_lr_mult": "0.0"},
+          "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]}],
+        [0, 1, 2], [[3, 0, 0]], version=800)
+    sym = load_json(js)
+    # loads, binds, and keeps the data as a hidden attr on the node
+    assert sym.attr_dict()["bn"]["__moving_mean_lr_mult__"] == "0.0"
+    ex = sym.simple_bind(mx.cpu(0), data=(2, 3))
+    assert ex.forward(data=mx.nd.ones((2, 3)))[0].shape == (2, 3)

@@ -304,3 +304,77 @@ def test_cpp_unit_harness(tmp_path):
                          text=True, timeout=120)
     assert run.returncode == 0, (run.stdout + run.stderr)[-2000:]
     assert "ALL NATIVE TESTS PASSED" in run.stdout
+
+
+@_jpeg
+def test_uint8_output_mode_matches_f32(tmp_path):
+    """output_dtype='uint8' (beyond-reference, r5): raw CHW bytes equal
+    the f32 pipeline's values exactly when no mean/std is applied — the
+    4x-smaller payload for the ship-bytes/normalize-on-device regime."""
+    from mxnet_tpu.image.io import ImageRecordIter, _NativeImageRecordIter
+    rec_path, idx_path = _write_img_rec(tmp_path, n=8)
+    u8 = ImageRecordIter(rec_path, (3, 32, 32), 4, resize=36,
+                         preprocess_threads=2, output_dtype="uint8")
+    f32 = ImageRecordIter(rec_path, (3, 32, 32), 4, resize=36,
+                          preprocess_threads=2)
+    assert isinstance(u8, _NativeImageRecordIter)
+    for _ in range(2):
+        bu, bf = u8.next(), f32.next()
+        du = bu.data[0].asnumpy()
+        assert du.dtype == np.uint8
+        np.testing.assert_array_equal(du.astype(np.float32),
+                                      bf.data[0].asnumpy())
+        np.testing.assert_array_equal(bu.label[0].asnumpy(),
+                                      bf.label[0].asnumpy())
+
+
+@_jpeg
+def test_uint8_mode_rejects_host_norm(tmp_path):
+    from mxnet_tpu.image.io import ImageRecordIter
+    rec_path, _ = _write_img_rec(tmp_path, n=4)
+    with pytest.raises(Exception, match="normalize on device"):
+        ImageRecordIter(rec_path, (3, 32, 32), 4, mean=True, std=True,
+                        output_dtype="uint8")
+
+
+def test_trainer_input_preproc_device_norm():
+    """DataParallelTrainer(input_preproc=...): uint8 batches normalized
+    INSIDE the compiled step match host-normalized f32 training."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import data_parallel_mesh, DataParallelTrainer
+
+    data = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=8,
+                               name="fc1")
+    sym = mx.sym.SoftmaxOutput(f1, name="softmax")
+    mesh = data_parallel_mesh(1)
+    rng = np.random.RandomState(0)
+    xu8 = rng.randint(0, 255, (8, 3, 4, 4)).astype(np.uint8)
+    y = rng.randint(0, 8, (8,)).astype(np.float32)
+    mean = np.float32(120.0)
+    scale = np.float32(1 / 64.0)
+
+    def preproc(name, v):
+        if name == "data":
+            return (v.astype(jnp.float32) - mean) * scale
+        return v
+
+    import jax
+    key = jax.random.PRNGKey(0)
+    t1 = DataParallelTrainer(sym, mesh, learning_rate=0.1,
+                             rescale_grad=1.0 / 8, input_preproc=preproc)
+    p1, s1, a1 = t1.init_state({"data": (8, 3, 4, 4),
+                                "softmax_label": (8,)})
+    p1, s1, a1, l1, _ = t1.step(p1, s1, a1,
+                                t1.shard_inputs([xu8, y]), rng=key)
+
+    t2 = DataParallelTrainer(sym, mesh, learning_rate=0.1,
+                             rescale_grad=1.0 / 8)
+    p2, s2, a2 = t2.init_state({"data": (8, 3, 4, 4),
+                                "softmax_label": (8,)})
+    xf = (xu8.astype(np.float32) - 120.0) / 64.0
+    p2, s2, a2, l2, _ = t2.step(p2, s2, a2,
+                                t2.shard_inputs([xf, y]), rng=key)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
