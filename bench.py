@@ -382,7 +382,10 @@ def _build_synth_rec(n=2560, size=256, seed=0):
     if os.path.exists(SYNTH_REC):
         return SYNTH_REC
     rng = np.random.RandomState(seed)
-    rec = recordio.MXRecordIO(SYNTH_REC, "w")
+    # build to a temp path + atomic rename: an interrupted build must not
+    # leave a truncated file that later runs silently treat as the cache
+    tmp = SYNTH_REC + f".build{os.getpid()}"
+    rec = recordio.MXRecordIO(tmp, "w")
     for i in range(n):
         # low-freq content + light noise: realistic JPEG size/decode cost
         base = rng.randint(0, 255, (8, 8, 3), np.uint8)
@@ -397,6 +400,7 @@ def _build_synth_rec(n=2560, size=256, seed=0):
         hdr = recordio.IRHeader(0, float(rng.randint(0, 1000)), i, 0)
         rec.write(recordio.pack(hdr, buf.tobytes()))
     rec.close()
+    os.replace(tmp, SYNTH_REC)
     return SYNTH_REC
 
 
@@ -611,6 +615,15 @@ def main():
     except Exception as e:
         fa_tps, fa_mfu = f"unavailable: {type(e).__name__}", None
     try:
+        # long-context lane (r5): seq 8192, auto 512-blocks — the curve
+        # through 32k is in docs/ROUND5.md (tools/attention_sweep.py)
+        fa8_tps, fa8_unit_flops = _flash_attention_tokens_per_sec(
+            batch=2, heads=8, seq=8192, dim=128)
+        fa8_tps = round(fa8_tps, 0)
+        fa8_mfu = _mfu(fa8_tps, fa8_unit_flops)
+    except Exception as e:
+        fa8_tps, fa8_mfu = f"unavailable: {type(e).__name__}", None
+    try:
         int8_ips = round(_int8_inference_ips(sym), 2)
     except Exception as e:
         int8_ips = f"unavailable: {type(e).__name__}"
@@ -673,6 +686,8 @@ def main():
         "lstm_lm_mfu": lstm_mfu,
         "attention_seq4096_flash_fwd_bwd_tokens_per_sec": fa_tps,
         "attention_mfu_model_flops": fa_mfu,
+        "attention_seq8192_flash_fwd_bwd_tokens_per_sec": fa8_tps,
+        "attention_seq8192_mfu_model_flops": fa8_mfu,
         "accuracy_lane_lenet_digits_val_acc": acc_lane,
         "timing": "median-of-3x80-steps (20 dispatches x K=4)",
         "secondary_lane_timing": "median-of-3 windows: rn152 10 steps, "

@@ -128,15 +128,11 @@ def _dead_bias_convs(symbol, topo):
     return dead
 
 
-def _build_runner(symbol, is_train, group2dev=None, platform=None):
+def _build_runner(symbol, is_train, platform=None):
     """Emit run(arg_values: tuple, aux_values: tuple, rng) ->
     (outputs tuple, new_aux tuple). Pure; jit-compiled by the caller.
-
-    `group2dev` maps `ctx_group` attr names (mx.AttrScope(ctx_group=...))
-    to jax devices: a node tagged with a mapped group gets its outputs
-    committed to that device inside the compiled program — the role of the
-    reference's PlaceDevice pass inserting _CrossDeviceCopy nodes
-    (graph_executor.cc:314,407); XLA emits the transfers.
+    (group2ctx model parallelism does NOT come through here — it runs
+    per-stage compiled segments, see _SegmentedRunner.)
     """
     topo = symbol._topo()
     args_n, aux_n = symbol._input_vars()
@@ -183,14 +179,9 @@ def _build_runner(symbol, is_train, group2dev=None, platform=None):
                 parsed["__bias_grad_dead__"] = True
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
-            # ctx_group nodes run on THEIR group's device: platform follows
-            # it so backend-specialized ops dispatch for the right target
-            grp_dev = _node_group_dev(node, group2dev)
-            node_platform = grp_dev.platform if grp_dev is not None \
-                else platform
-            octx = OpCtx(is_train=is_train, rng=key, platform=node_platform)
+            octx = OpCtx(is_train=is_train, rng=key, platform=platform)
             if do_mirror:
-                def _call(k, *a, _op=node.op, _p=parsed, _pf=node_platform):
+                def _call(k, *a, _op=node.op, _p=parsed, _pf=platform):
                     return _op.fcompute(
                         _p, OpCtx(is_train=True, rng=k, platform=_pf), *a)
                 res = jax.checkpoint(_call)(key, *ins)
@@ -198,8 +189,6 @@ def _build_runner(symbol, is_train, group2dev=None, platform=None):
                 res = node.op.fcompute(parsed, octx, *ins)
             if not isinstance(res, tuple):
                 res = (res,)
-            if grp_dev is not None:
-                res = tuple(jax.device_put(r, grp_dev) for r in res)
             n_out = node.num_outputs()
             vals[pos] = res[:n_out]
             if node.op.mutates_aux and (is_train or node.op.aux_always):

@@ -19,9 +19,22 @@ import jax.numpy as jnp
 
 from .registry import Param, register
 
-_BLOCK_Q = 128
+_BLOCK_Q = 128    # floor tile; _auto_block picks larger when S allows
 _BLOCK_K = 128
 _LSE_LANES = 8    # minor replication of the per-row lse (TPU block tiling)
+
+
+def _auto_block(s):
+    """Default block size: the LARGEST of 512/256/128 dividing S. The r5
+    sweep (tools/attention_sweep.py, docs/ROUND5.md) measured 512-blocks
+    at ~1.9x the r4 default 128 on v5e (seq 4096 causal fwd+bwd: 984k vs
+    527k tok/s) — bigger tiles amortize the per-block softmax bookkeeping
+    and keep the MXU busier. Sequences not divisible by 128 fall back to
+    a single block (small-S case)."""
+    for blk in (512, 256, 128):
+        if s % blk == 0:
+            return blk
+    return min(_BLOCK_Q, s)
 
 
 def _t(*o):
@@ -38,9 +51,14 @@ def reference_attention_with_lse(q, k, v, causal=False, scale=None):
     """Dense oracle returning (out, lse (B,H,S) f32) — the merge
     statistic blockwise/ring combiners need. Rows with NO valid key get
     out=0 and lse=-inf (the logsumexp of an empty set), so such a block
-    contributes exactly nothing to a logaddexp merge."""
+    contributes exactly nothing to a logaddexp merge. GQA (k/v with
+    fewer heads) is handled by repeating kv across each query group."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if k.shape[1] != q.shape[1]:
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     if causal:
@@ -245,18 +263,57 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_pallas(q, k, v, causal, scale, interpret=False):
-    """Forward kernel. q/k/v (B, H, S, D) with S % block == 0 and
+def _vmem_params(s, d, n_full_streams, interpret, itemsize=2):
+    """Mosaic compiler params for long sequences: the kernels keep
+    full-length (S, D) K/V (and, in the backward, Q/dO/O) refs resident
+    in VMEM with double buffering across grid cells; past ~8k tokens
+    that legitimately exceeds the default 16MB scoped-vmem budget
+    (measured on v5e: s=12288 wants 16.7M). Raise the per-kernel limit
+    toward the physical VMEM when the estimate calls for it — the
+    budget is a compiler default, not the hardware bound."""
+    if interpret:
+        return {}
+    need = n_full_streams * s * d * itemsize * 2   # x2 double buffering
+    if need <= 8 * 2 ** 20:
+        # q/out blocks + lse + scratch ride within the default budget
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+    limit = min(110 * 2 ** 20, int(need * 1.5) + 16 * 2 ** 20)
+    return {"compiler_params": pltpu.CompilerParams(
+        vmem_limit_bytes=limit)}
+
+
+def _kv_index_map(h, h_kv):
+    """Grid-index map for K/V refs under GQA: q-head `bh % h` reads kv
+    head `(bh % h) // group` — the kernels stream the SHARED kv block
+    straight from HBM, no repeated copy is ever materialized."""
+    if h == h_kv:
+        return lambda bh, i: (bh, 0, 0)
+    group = h // h_kv
+    return lambda bh, i: ((bh // h) * h_kv + (bh % h) // group, 0, 0)
+
+
+def _flash_pallas(q, k, v, causal, scale, interpret=False, block_q=None,
+                  block_k=None):
+    """Forward kernel. q (B, H, S, D), k/v (B, H_kv, S, D) with
+    H % H_kv == 0 (GQA/MQA share kv blocks in-kernel), S % block == 0 and
     D % 128 == 0 (or 64). Returns (out (B,H,S,D), lse (B*H, S, 8) f32 —
     the row statistic lane-replicated for TPU block tiling)."""
     import jax.experimental.pallas as pl
 
     b, h, s, d = q.shape
-    block_q = min(_BLOCK_Q, s)
-    block_k = min(_BLOCK_K, s)
+    h_kv = k.shape[1]
+    block_q = min(block_q or _auto_block(s), s)
+    block_k = min(block_k or _auto_block(s), s)
+    if s % block_q or s % block_k:
+        # forced/explicit blocks that don't tile S would silently leave
+        # grid-truncated output rows unwritten
+        raise ValueError(f"flash attention: seq {s} is not divisible by "
+                         f"blocks ({block_q}, {block_k})")
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * h_kv, s, d)
+    vf = v.reshape(b * h_kv, s, d)
+    kv_map = _kv_index_map(h, h_kv)
     kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=s,
                                causal=causal, scale=scale)
     out, lse = pl.pallas_call(
@@ -264,8 +321,8 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
         grid=(b * h, s // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), kv_map),
+            pl.BlockSpec((None, s, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -277,25 +334,36 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
             _sds((b * h, s, _LSE_LANES), jnp.float32, q),
         ],
         interpret=interpret,
+        **_vmem_params(s, d, 2, interpret, q.dtype.itemsize),
     )(qf, kf, vf)
     return out.reshape(b, h, s, d), lse
 
 
 def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
-                      g_lse=None):
+                      g_lse=None, block_q=None, block_k=None):
     """Recompute-based flash backward: two single-HBM-pass kernels (dQ
     gridded over q-blocks; dK/dV over k-blocks) re-derive the softmax
     from the saved lse — O(S) extra memory, never an (S, S) tensor.
     g_lse (B, H, S) is the lse output's cotangent when lse is itself a
-    differentiated output (blockwise/ring merging); None means zeros."""
+    differentiated output (blockwise/ring merging); None means zeros.
+    GQA: kv blocks stream shared via the index map (like the forward);
+    the dK/dV kernel still produces PER-Q-HEAD partials, reduced over
+    each group outside the kernel (one cheap XLA sum — the simple,
+    correct realization; an in-kernel cross-head accumulation would
+    need grid-order-dependent output aliasing)."""
     import jax.experimental.pallas as pl
 
     b, h, s, d = q.shape
-    block_q = min(_BLOCK_Q, s)
-    block_k = min(_BLOCK_K, s)
+    h_kv = k.shape[1]
+    kv_map = _kv_index_map(h, h_kv)
+    block_q = min(block_q or _auto_block(s), s)
+    block_k = min(block_k or _auto_block(s), s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"flash attention bwd: seq {s} is not divisible "
+                         f"by blocks ({block_q}, {block_k})")
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, s, d)
-    vf = v.reshape(b * h, s, d)
+    kf = k.reshape(b * h_kv, s, d)
+    vf = v.reshape(b * h_kv, s, d)
     dof = g.reshape(b * h, s, d)
     of = o.reshape(b * h, s, d)
     have_glse = g_lse is not None
@@ -319,6 +387,7 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
             k=kernel)
 
     full_spec = pl.BlockSpec((None, s, d), lambda bh, i: (bh, 0, 0))
+    kv_full = pl.BlockSpec((None, s, d), kv_map)
     lse_full = pl.BlockSpec((None, s, _LSE_LANES), lambda bh, i: (bh, 0, 0))
     lse_blk = pl.BlockSpec((None, block_q, _LSE_LANES),
                            lambda bh, qi: (bh, qi, 0))
@@ -331,7 +400,7 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
         grid=(b * h, s // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            full_spec, full_spec,
+            kv_full, kv_full,
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
             lse_blk,
@@ -340,8 +409,17 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
                                lambda bh, qi: (bh, qi, 0)),
         out_shape=_sds((b * h, s, d), q.dtype, q),
         interpret=interpret,
+        **_vmem_params(s, d, 2, interpret, q.dtype.itemsize),
     )(qf, kf, vf, dof, of, lse, *glse_args)
 
+    if h == h_kv:
+        kv_blk = pl.BlockSpec((None, block_k, d),
+                              lambda bh, ki: (bh, ki, 0))
+    else:
+        group = h // h_kv
+        kv_blk = pl.BlockSpec(
+            (None, block_k, d),
+            lambda bh, ki: ((bh // h) * h_kv + (bh % h) // group, ki, 0))
     dkv_kernel = _with_optional_glse(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           seq_len=s, causal=causal, scale=scale), 6)
@@ -349,9 +427,7 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
         dkv_kernel,
         grid=(b * h, s // block_k),
         in_specs=[
-            full_spec,
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            full_spec, kv_blk, kv_blk,
             full_spec, full_spec, lse_full,
         ] + ([lse_full] if have_glse else []),
         out_specs=[
@@ -363,19 +439,31 @@ def _flash_pallas_bwd(q, k, v, o, lse, g, causal, scale, interpret=False,
             _sds((b * h, s, d), v.dtype, q),
         ],
         interpret=interpret,
+        **_vmem_params(s, d, 3, interpret, q.dtype.itemsize),
     )(qf, kf, vf, dof, of, lse, *glse_args)
 
-    shape = (b, h, s, d)
-    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+    dq = dq.reshape(b, h, s, d)
+    dk = dk.reshape(b, h, s, d)
+    dv = dv.reshape(b, h, s, d)
+    if h != h_kv:
+        group = h // h_kv
+        dk = dk.reshape(b, h_kv, group, s, d).sum(2).astype(k.dtype)
+        dv = dv.reshape(b, h_kv, group, s, d).sum(2).astype(v.dtype)
+    return dq, dk, dv
 
 
-def _pallas_eligible(q, k, platform=None):
+def _pallas_eligible(q, k, platform=None, block_q=None, block_k=None):
     b, h, s, d = q.shape
     if k.shape != q.shape:
-        return False          # cross-attention: XLA path handles s_q != s_k
+        # GQA/MQA (fewer kv heads, same seq) stays kernel-eligible; true
+        # cross-attention (s_q != s_k) goes to the XLA path
+        if k.shape[0] != b or k.shape[2] != s or k.shape[3] != d \
+                or k.shape[1] == 0 or h % k.shape[1] != 0:
+            return False
     if d % 128 != 0 and d not in (64,):
         return False
-    if s % min(_BLOCK_Q, s) != 0 or s % min(_BLOCK_K, s) != 0:
+    if s % min(block_q or _auto_block(s), s) != 0 or \
+            s % min(block_k or _auto_block(s), s) != 0:
         return False
     if s < 8:
         return False
@@ -426,7 +514,8 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
     return fn(q, k, v)
 
 
-def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):
+def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False,
+                            block_q=None, block_k=None):
     """Pallas forward + Pallas recompute-based backward (FlashAttention-2
     style): the forward saves only O and the per-row logsumexp; the
     backward re-materializes softmax blocks from them in VMEM. Activation
@@ -435,25 +524,28 @@ def _flash_pallas_trainable(q, k, v, causal, scale, interpret=False):
 
     @jax.custom_vjp
     def fn(q, k, v):
-        out, _ = _flash_pallas(q, k, v, causal, scale, interpret=interpret)
+        out, _ = _flash_pallas(q, k, v, causal, scale, interpret=interpret,
+                               block_q=block_q, block_k=block_k)
         return out
 
     def fwd(q, k, v):
         out, lse = _flash_pallas(q, k, v, causal, scale,
-                                 interpret=interpret)
+                                 interpret=interpret, block_q=block_q,
+                                 block_k=block_k)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
         q, k, v, out, lse = res
         return _flash_pallas_bwd(q, k, v, out, lse, g, causal, scale,
-                                 interpret=interpret)
+                                 interpret=interpret, block_q=block_q,
+                                 block_k=block_k)
 
     fn.defvjp(fwd, bwd)
     return fn(q, k, v)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, force=None,
-                    platform=None):
+                    platform=None, block_q=None, block_k=None):
     """Blockwise attention: Pallas kernel on TPU, fused XLA otherwise.
 
     force: None (auto) | 'pallas' | 'xla' | 'interpret' (kernel under the
@@ -461,6 +553,11 @@ def flash_attention(q, k, v, causal=False, scale=None, force=None,
     platform when the caller compiles for a specific device (the executor
     plumbs it via OpCtx); auto mode must not pick the pallas path for a
     cpu-targeted program just because the DEFAULT backend is a TPU.
+
+    GQA/MQA: k/v may carry fewer heads than q (H % H_kv == 0) — the
+    kernels stream the SHARED kv blocks (no repeated copy; dK/dV group
+    partials reduce outside the kernel). block_q/block_k override the
+    default 128 tiling (tools/attention_sweep.py measures the curve).
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -468,10 +565,13 @@ def flash_attention(q, k, v, causal=False, scale=None, force=None,
         return reference_attention(q, k, v, causal, scale)
     if force == "interpret":
         return _flash_pallas_trainable(q, k, v, causal, scale,
-                                       interpret=True)
+                                       interpret=True, block_q=block_q,
+                                       block_k=block_k)
     if force == "pallas" or (force is None and
-                             _pallas_eligible(q, k, platform)):
-        return _flash_pallas_trainable(q, k, v, causal, scale)
+                             _pallas_eligible(q, k, platform, block_q,
+                                              block_k)):
+        return _flash_pallas_trainable(q, k, v, causal, scale,
+                                       block_q=block_q, block_k=block_k)
     return reference_attention(q, k, v, causal, scale)
 
 

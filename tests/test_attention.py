@@ -138,3 +138,67 @@ def test_flash_with_lse_grads_include_lse_cotangent(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_dense(causal, h_kv):
+    """GQA/MQA (k/v with fewer heads): kernel fwd+bwd == dense oracle
+    (which repeats kv per group). h=4 with h_kv in {2 (GQA), 1 (MQA)}."""
+    q, _, _ = _qkv(b=1, h=4, s=256, d=128, seed=31)
+    _, k, v = _qkv(b=1, h=h_kv, s=256, d=128, seed=32)
+
+    def loss_flash(q, k, v):
+        out = at.flash_attention(q, k, v, causal=causal,
+                                 force="interpret")
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_dense(q, k, v):
+        out = at.reference_attention(q, k, v, causal=causal)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    with jax.default_matmul_precision("highest"):
+        o1 = at.flash_attention(q, k, v, causal=causal, force="interpret")
+        o2 = at.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-3, atol=2e-4)
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    assert got[1].shape == k.shape and got[2].shape == v.shape
+    for name, a, b in zip("qkv", got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=3e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_block_size_override_matches():
+    """block_q/block_k overrides change tiling, not math."""
+    q, k, v = _qkv(b=1, h=2, s=512, d=128, seed=33)
+    base = at.flash_attention(q, k, v, causal=True, force="interpret")
+    for bq, bk in ((256, 128), (128, 256), (256, 256)):
+        out = at.flash_attention(q, k, v, causal=True, force="interpret",
+                                 block_q=bq, block_k=bk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"bq={bq} bk={bk}")
+
+
+def test_gqa_eligibility():
+    import numpy as _np
+    q = jnp.zeros((2, 8, 256, 128), jnp.bfloat16)
+    kv = jnp.zeros((2, 2, 256, 128), jnp.bfloat16)
+    assert at._pallas_eligible(q, kv, platform="tpu")
+    # true cross-attention stays ineligible
+    cross = jnp.zeros((2, 8, 128, 128), jnp.bfloat16)
+    assert not at._pallas_eligible(q, cross, platform="tpu")
+    # non-divisible head group ineligible
+    kv3 = jnp.zeros((2, 3, 256, 128), jnp.bfloat16)
+    assert not at._pallas_eligible(q, kv3, platform="tpu")
+
+
+def test_forced_indivisible_blocks_error():
+    """Explicit blocks that don't tile S must raise, not truncate the
+    grid and leave output rows unwritten."""
+    q, k, v = _qkv(b=1, h=1, s=384, d=128, seed=40)
+    with pytest.raises(ValueError, match="not divisible"):
+        at.flash_attention(q, k, v, force="interpret", block_q=256)
