@@ -14,3 +14,55 @@ import jax  # noqa: E402
 
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# -- the `quick` tier (pytest -m quick): one representative test per
+# subsystem, kept under 2 minutes total, so a fast green bar exists
+# between full (~15 min) runs. Centralized here instead of scattering
+# @pytest.mark.quick decorators: the tier is a curated LIST, and curating
+# it in one place keeps the runtime budget reviewable.
+_QUICK = {
+    "test_ndarray.py::test_arithmetic_broadcast",
+    "test_ndarray.py::test_csr_duplicate_entries_canonicalized",
+    "test_symbol.py::test_infer_shape_conv_net",
+    "test_operator.py::test_convolution",
+    "test_op_gradients.py::test_binary_gradient",
+    "test_autograd.py::test_chain_and_broadcast_backward",
+    "test_module.py::test_module_fit_mlp_converges",
+    "test_module_family.py::test_group2ctx_executes",
+    "test_multistep.py::test_step_k_matches_sequential",
+    "test_segmented_mp.py::test_stage_placement",
+    "test_gluon.py::test_dense_eager_hybrid_match",
+    "test_gluon.py::test_dataloader_process_workers_match_threads",
+    "test_io.py::test_ndarray_iter_basic",
+    "test_native.py::test_uint8_output_mode_matches_f32",
+    "test_optimizer.py::test_sgd_mom_update_op",
+    "test_metric.py::test_accuracy",
+    "test_kvstore.py::test_aggregator_multi_device",
+    "test_kvstore.py::test_async_sync_fallback_warns",
+    "test_parallel.py::test_build_mesh",
+    "test_parallel.py::test_dp_matches_single_device",
+    "test_attention.py::test_flash_kernel_single_and_multi_block",
+    "test_sp.py::test_ring_attention_matches_dense",
+    "test_rnn.py::test_rnn_cell_unroll_shapes",
+    "test_container.py::"
+    "test_written_file_is_byte_identical_to_reference_layout",
+    "test_legacy_json.py::test_reference_v1_json_loads_and_binds",
+    "test_model_store.py::test_verified_cache_hit",
+    "test_export_predictor.py::test_predictor_contract",
+    "test_feedforward.py::test_feedforward_predict_return_data",
+    "test_quantization.py::test_quantize_dequantize_roundtrip",
+    "test_sparse_optimizer.py::test_sgd_lazy_update_touches_only_grad_rows",
+    "test_image.py::test_crops_and_normalize",
+    "test_profiler.py::test_print_summary",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.nodeid.split("/")[-1]
+        # strip parametrization: tier membership is per test function
+        fn = base.split("[")[0]
+        if fn in _QUICK:
+            item.add_marker(pytest.mark.quick)

@@ -431,3 +431,45 @@ def test_split_and_load_clip_global_norm():
     parts1 = gluon.utils.split_and_load(np.arange(12).reshape(6, 2),
                                         [mx.cpu(0)])
     assert len(parts1) == 1 and parts1[0].shape == (6, 2)
+
+
+class _SlowPyDataset:
+    """GIL-bound python transform (pure-python per-pixel loop)."""
+    def __init__(self, n=64, size=24):
+        rng = np.random.RandomState(0)
+        self._x = rng.uniform(0, 1, (n, size)).astype(np.float32)
+
+    def __len__(self):
+        return len(self._x)
+
+    def __getitem__(self, i):
+        row = self._x[i]
+        out = [0.0] * len(row)
+        for j in range(len(row)):        # deliberately GIL-bound
+            out[j] = float(row[j]) * 2.0 + 1.0
+        return np.asarray(out, np.float32), np.float32(i % 3)
+
+
+def test_dataloader_process_workers_match_threads():
+    """worker_type='process' (reference's forked-worker model) yields the
+    same batches as threads/inline for the default batchify."""
+    from mxnet_tpu.gluon.data import DataLoader
+    ds = _SlowPyDataset(n=32)
+    outs = {}
+    for wt, nw in (("thread", 0), ("thread", 2), ("process", 2)):
+        dl = DataLoader(ds, batch_size=8, shuffle=False, num_workers=nw,
+                        worker_type=wt)
+        outs[(wt, nw)] = [[np.asarray(c.asnumpy()) for c in b]
+                          for b in dl]
+    base = outs[("thread", 0)]
+    for key, got in outs.items():
+        assert len(got) == len(base), key
+        for b1, b2 in zip(base, got):
+            for c1, c2 in zip(b1, b2):
+                np.testing.assert_allclose(c1, c2, err_msg=str(key))
+
+
+def test_dataloader_worker_type_validation():
+    from mxnet_tpu.gluon.data import DataLoader
+    with pytest.raises(ValueError, match="worker_type"):
+        DataLoader(_SlowPyDataset(8), batch_size=4, worker_type="bogus")
