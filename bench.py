@@ -477,6 +477,8 @@ def _e2e_data_lane(sym, mesh, steps=20):
                                                     inputs)
     float(loss)
     e2e_ips = steps * TRAIN_BATCH / (time.perf_counter() - t0)
+    if hasattr(it, "close"):
+        it.close()   # join the native decode workers before later lanes
     return e2e_ips, pipe_ips
 
 

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# CI entry point (role of the reference's Jenkinsfile stages: sanity,
+# build, unit tests, nightly).
+#
+#   tools/ci.sh quick    — install + 30s cross-subsystem smoke tier
+#   tools/ci.sh full     — install + full CPU-mesh suite (~15 min)
+#   tools/ci.sh tpu      — real-chip lane (needs a TPU backend)
+#   tools/ci.sh bench    — canonical perf JSON line (needs a TPU)
+#
+# All stages run on the 8-device virtual CPU mesh except tpu/bench.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage="${1:-quick}"
+
+echo "== install (editable, offline-safe)"
+pip install -e . --no-deps --no-build-isolation -q
+
+echo "== compile check (native runtime + package import)"
+python - <<'EOF'
+import mxnet_tpu as mx
+from mxnet_tpu import _native
+print("package:", mx.__name__, "| native lib:",
+      "ok" if _native.lib() is not None else "python-fallback")
+EOF
+
+case "$stage" in
+  quick)
+    python -m pytest tests/ -m quick -q ;;
+  full)
+    python -m pytest tests/ -q ;;
+  tpu)
+    python -m pytest tests_tpu/ -q ;;
+  bench)
+    python bench.py ;;
+  *)
+    echo "unknown stage: $stage (quick|full|tpu|bench)" >&2; exit 2 ;;
+esac
+echo "== ci stage '$stage' green"
