@@ -14,6 +14,7 @@ import threading
 import numpy as _np
 
 __all__ = [
+    "to_numpy",
     "MXNetError", "string_types", "numeric_types",
     "DTYPES", "np_dtype", "dtype_name",
     "NameManager", "AttrScope",
@@ -44,6 +45,12 @@ DTYPES = {
     "bool": _np.dtype("bool"),
 }
 _NAME_OF = {v: k for k, v in DTYPES.items()}
+
+
+def to_numpy(a):
+    """Host numpy view of an NDArray / jax array / array-like (the
+    `getattr(a, "_data", a)` unwrap used across the training drivers)."""
+    return _np.asarray(getattr(a, "_data", a))
 
 
 def np_dtype(dtype):

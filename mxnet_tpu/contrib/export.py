@@ -58,12 +58,10 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
                          "not arguments of the symbol")
     param_names = [n for n in arg_names if n not in data_shapes]
 
+    from ..base import to_numpy as _np_of
     shape_kwargs = dict(data_shapes)
     arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
     inferred = dict(zip(arg_names, arg_shapes))
-
-    def _np_of(a):
-        return _np.asarray(getattr(a, "_data", a))
 
     param_vals = []
     for n in param_names:
@@ -140,7 +138,6 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
             *in_specs, *par_specs, *aux_specs, rng_spec)
 
     from ..ndarray import container
-    import io as _io
     import tempfile
     import os
     manifest = {

@@ -104,8 +104,7 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
         aux_params={n: pmap[n].data() for n in trainer.aux_names
                     if n in pmap})
 
-    def _np_of(a):
-        return np.asarray(getattr(a, "_data", a))
+    from ..base import to_numpy as _np_of
 
     def _writeback():
         # COPY out of the training state: step_k donates its params/states

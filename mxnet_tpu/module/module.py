@@ -423,11 +423,9 @@ class Module(BaseModule):
             shape_kwargs, arg_params=self._arg_params,
             aux_params=self._aux_params)
 
+        from ..base import to_numpy as _np_of
         data_idx = {n: i for i, n in enumerate(self._data_names)}
         label_idx = {n: i for i, n in enumerate(self._label_names)}
-
-        def _np_of(a):
-            return np.asarray(getattr(a, "_data", a))
 
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
