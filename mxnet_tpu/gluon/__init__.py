@@ -2,7 +2,7 @@
 from .parameter import Parameter, Constant, ParameterDict, \
     DeferredInitializationError
 from .block import Block, HybridBlock, SymbolBlock
-from .trainer import Trainer
+from .trainer import Trainer, fused_fit
 from . import nn
 from . import loss
 from . import data
@@ -11,5 +11,5 @@ from . import model_zoo
 from . import rnn
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "loss", "data", "utils",
+           "SymbolBlock", "Trainer", "fused_fit", "nn", "loss", "data", "utils",
            "model_zoo", "rnn"]
