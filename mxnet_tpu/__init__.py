@@ -57,4 +57,5 @@ from . import parallel
 from . import image
 from . import gluon
 from . import rnn
+from . import serving
 from . import test_utils

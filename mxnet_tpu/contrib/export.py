@@ -31,6 +31,19 @@ PARAMS_FILE = "params.bin"
 FORMAT_VERSION = 1
 
 
+def serving_buckets(max_batch):
+    """Power-of-two batch-bucket ladder for a given exported batch:
+    1, 2, 4, ... capped at (and always including) max_batch."""
+    if max_batch < 1:
+        raise MXNetError("serving_buckets: max_batch must be >= 1")
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_batch))
+    return buckets
+
+
 def export_model(path, symbol, arg_params, aux_params, data_shapes,
                  dtype="float32", platforms=None):
     """Serialize an inference-ready model to `path` (.mxa artifact).
@@ -140,6 +153,18 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     from ..ndarray import container
     import tempfile
     import os
+    # serving metadata: the exported batch (axis 0 of the inputs) plus the
+    # power-of-two bucket ladder mxnet_tpu.serving uses for its compiled-
+    # plan cache (any request batch <= max_batch is servable by padding to
+    # the nearest bucket; see serving/engine.py). Purely additive — old
+    # predictors ignore the key.
+    batch_sizes = {int(data_shapes[n][0]) for n in input_names
+                   if len(data_shapes[n]) > 0}
+    serving_meta = None
+    if len(batch_sizes) == 1:
+        max_batch = batch_sizes.pop()
+        serving_meta = {"batch_axis": 0, "max_batch": max_batch,
+                        "buckets": serving_buckets(max_batch)}
     manifest = {
         "format_version": FORMAT_VERSION,
         "inputs": [{"name": n, "shape": list(data_shapes[n]),
@@ -150,6 +175,8 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
         "dtype": dtype,
         "platforms": list(platforms),
     }
+    if serving_meta is not None:
+        manifest["serving"] = serving_meta
     with tempfile.TemporaryDirectory() as td:
         pfile = os.path.join(td, PARAMS_FILE)
         # container.save_container takes raw numpy directly

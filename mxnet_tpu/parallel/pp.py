@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
+from ._compat import pcast as _pcast
+from ._compat import shard_map as _shard_map
 
 __all__ = ["pipeline_mlp", "pipeline_reference"]
 
@@ -42,9 +44,9 @@ def _pipe_shard(x_micro, w, b, axis_name, n_micro):
 
     # pcast-to-varying marks the carries as device-varying so the fori_loop
     # carry typecheck accepts the (rank-dependent) tick outputs
-    y0 = jax.lax.pcast(jnp.zeros((bsz, d), x_micro.dtype), (axis_name,),
+    y0 = _pcast(jnp.zeros((bsz, d), x_micro.dtype), (axis_name,),
                        to="varying")
-    outs0 = jax.lax.pcast(jnp.zeros((n_micro, bsz, d), x_micro.dtype),
+    outs0 = _pcast(jnp.zeros((n_micro, bsz, d), x_micro.dtype),
                           (axis_name,), to="varying")
 
     def tick(t, carry):
@@ -81,7 +83,7 @@ def pipeline_mlp(x_micro, w_stack, b_stack, mesh, axis_name="pp"):
         raise MXNetError(
             f"pipeline_mlp: {w_stack.shape[0]} stages but {axis_name} axis "
             f"has {n} devices (one stage per device)")
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_pipe_shard, axis_name=axis_name,
                           n_micro=x_micro.shape[0]),
         mesh=mesh,

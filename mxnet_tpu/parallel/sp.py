@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
+from ._compat import shard_map as _shard_map
 
 __all__ = ["attention_reference", "ring_attention", "ulysses_attention"]
 
@@ -204,7 +205,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
             platform=mesh.devices.flat[0].platform)
     else:
         raise MXNetError(f"ring_attention: unknown impl {impl!r}")
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)
 
@@ -247,7 +248,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
             f"ulysses_attention: sequence {q.shape[2]} not divisible by "
             f"{axis_name}={nsp}")
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ulysses_shard, axis_name=axis_name,
                           causal=causal, scale=scale,
                           platform=mesh.devices.flat[0].platform),

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
+from ._compat import shard_map as _shard_map
 
 __all__ = ["megatron_mlp", "moe_ffn", "moe_ffn_reference"]
 
@@ -51,7 +52,7 @@ def megatron_mlp(x, w1, b1, w2, b2, mesh, axis_name="tp"):
         raise MXNetError(
             f"megatron_mlp: hidden dim {w1.shape[1]} not divisible by "
             f"{axis_name}={n}")
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_mlp_shard, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(), P(None, axis_name), P(axis_name),
@@ -100,7 +101,7 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="ep"):
     if n_experts % n != 0:
         raise MXNetError(f"moe_ffn: {n_experts} experts not divisible by "
                          f"{axis_name}={n}")
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_moe_shard, axis_name=axis_name,
                           experts_per_dev=n_experts // n),
         mesh=mesh,

@@ -125,9 +125,33 @@ class Predictor:
         return [(n, tuple(o.shape))
                 for n, o in zip(self.manifest["outputs"], outs)]
 
+    @property
+    def batch_axis(self):
+        return int(self.manifest.get("serving", {}).get("batch_axis", 0))
+
+    @property
+    def export_batch(self):
+        """Batch dimension the artifact was bound at (MXPredCreate's
+        fixed shape). Request batches up to this size are servable via
+        the pad-and-slice path in forward()."""
+        serving = self.manifest.get("serving", {})
+        if "max_batch" in serving:
+            return int(serving["max_batch"])
+        ax = self.batch_axis
+        return int(self._input_shapes[self._input_names[0]][ax])
+
     def forward(self, *args, **kwargs):
         """Run inference. Inputs positionally (manifest order) or by
-        name; returns a list of numpy arrays (one per output)."""
+        name; returns a list of numpy arrays (one per output).
+
+        Request batches SMALLER than the exported batch are accepted:
+        inputs whose shape matches the exported shape everywhere except a
+        smaller batch axis are zero-padded up to the exported batch, and
+        outputs carrying the batch axis are sliced back to the request
+        batch. Padding rows are inert at inference (BatchNorm uses
+        running stats; per-row heads never mix rows), so real rows are
+        untouched. Larger or otherwise-mismatched shapes still raise the
+        MXPredCreate fixed-shape contract error."""
         import jax
         if args and kwargs:
             raise ValueError("pass inputs positionally or by name, "
@@ -144,19 +168,43 @@ class Predictor:
         if len(args) != len(self._input_names):
             raise ValueError(f"expected {len(self._input_names)} inputs "
                              f"{self._input_names}, got {len(args)}")
-        feed = []
+        ax = self.batch_axis
+        exp_batch = self.export_batch
+        feed, req_batch = [], None
         for n, a in zip(self._input_names, args):
             a = np.asarray(getattr(a, "_data", a), dtype=np.float32) \
                 if not isinstance(a, np.ndarray) else a
-            if tuple(a.shape) != self._input_shapes[n]:
-                raise ValueError(
-                    f"input {n!r}: shape {tuple(a.shape)} does not match "
-                    f"the exported shape {self._input_shapes[n]} (shapes "
-                    "are bound at export time, as in MXPredCreate)")
+            want = self._input_shapes[n]
+            got = tuple(a.shape)
+            if got != want:
+                padded_ok = (
+                    len(got) == len(want) and len(got) > ax and
+                    got[ax] < want[ax] and got[ax] >= 1 and
+                    want[ax] == exp_batch and
+                    got[:ax] + got[ax + 1:] == want[:ax] + want[ax + 1:])
+                if not padded_ok:
+                    raise ValueError(
+                        f"input {n!r}: shape {got} does not match "
+                        f"the exported shape {want} (shapes "
+                        "are bound at export time, as in MXPredCreate)")
+                if req_batch is None:
+                    req_batch = got[ax]
+                elif req_batch != got[ax]:
+                    raise ValueError(
+                        f"input {n!r}: request batch {got[ax]} disagrees "
+                        f"with other inputs' batch {req_batch}")
+                pad = [(0, 0)] * len(got)
+                pad[ax] = (0, want[ax] - got[ax])
+                a = np.pad(np.asarray(a, np.float32), pad)
             feed.append(jax.device_put(np.asarray(a, np.float32),
                                        self._dev))
         outs = self._exp.call(*feed, *self._state, self._rng)
-        return [np.asarray(o) for o in outs]
+        outs = [np.asarray(o) for o in outs]
+        if req_batch is not None:
+            outs = [o[(slice(None),) * ax + (slice(0, req_batch),)]
+                    if o.ndim > ax and o.shape[ax] == exp_batch else o
+                    for o in outs]
+        return outs
 
 
 def main(argv=None):

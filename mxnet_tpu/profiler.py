@@ -24,7 +24,8 @@ import threading
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state", "Domain", "Task",
-           "Counter", "Marker", "Frame"]
+           "Counter", "Marker", "Frame", "register_counter_export",
+           "unregister_counter_export", "export_counters"]
 
 _lock = threading.Lock()
 _state = "stop"
@@ -168,10 +169,52 @@ def profile_op(name, run):
     return out
 
 
+# -- counter export hooks ---------------------------------------------------
+# Subsystems with their own live counters (e.g. mxnet_tpu.serving.metrics)
+# register a snapshot callable here; export_counters() merges every
+# registered snapshot into one dict, and dump() embeds it in the trace file
+# so a single profile JSON carries both the timeline and the counters.
+_counter_exports = {}
+
+
+def register_counter_export(name, fn):
+    """Register `fn() -> dict` under `name`. Re-registering a name
+    replaces the previous hook (latest owner wins)."""
+    if not callable(fn):
+        raise ValueError("register_counter_export: fn must be callable")
+    with _lock:
+        _counter_exports[name] = fn
+
+
+def unregister_counter_export(name):
+    with _lock:
+        _counter_exports.pop(name, None)
+
+
+def export_counters(format="dict"):
+    """Snapshot every registered counter hook: {name: fn()}.
+    A hook that raises is reported as {"error": ...} rather than taking
+    the export down (serving keeps running while being observed)."""
+    with _lock:
+        hooks = list(_counter_exports.items())
+    out = {}
+    for name, fn in hooks:
+        try:
+            out[name] = fn()
+        except Exception as e:                       # pragma: no cover
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    if format == "json":
+        return json.dumps(out)
+    return out
+
+
 def dump(finished=True, profile_process="worker"):
     """Write the chrome-trace JSON (chrome://tracing / perfetto loadable)."""
     with _lock:
         trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    counters = export_counters()
+    if counters:
+        trace["counters"] = counters
     path = _config["filename"]
     with open(path, "w") as f:
         json.dump(trace, f)

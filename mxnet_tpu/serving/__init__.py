@@ -1,0 +1,37 @@
+"""mxnet_tpu.serving — dynamic-batching inference runtime over .mxa
+artifacts: the fourth pillar (train / export / predict / **serve**).
+
+Composition (each piece is independently usable):
+
+    engine.ServingEngine   bucketed compiled-plan cache over a Predictor
+                           (power-of-two batch buckets, pad-and-slice,
+                           warmup) — one XLA program per bucket.
+    batcher.DynamicBatcher micro-batches concurrent requests up to
+                           max_batch / max_wait_us over a bounded queue,
+                           with per-request deadlines and load shedding.
+    metrics.ServingMetrics QPS / p50 / p99 / batch histogram / queue
+                           depth / shed count, exported through
+                           mx.profiler's counter-export hook.
+
+Quick start:
+
+    from mxnet_tpu import serving
+    eng = serving.ServingEngine("model.mxa")          # warms all buckets
+    with serving.DynamicBatcher(eng, max_wait_us=2000,
+                                queue_depth=256) as bat:
+        out = bat.infer(x_row)                        # from any thread
+    print(bat.metrics.to_json())
+
+CLI: `python -m mxnet_tpu.serving model.mxa --selftest` runs a
+closed-loop load generator against the batcher and prints a one-line
+perf JSON (tiny built-in convnet when no artifact is given).
+"""
+from __future__ import annotations
+
+from .engine import ServingEngine
+from .batcher import (DynamicBatcher, Future, RequestTimeout,
+                      ServingQueueFull)
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "DynamicBatcher", "ServingMetrics",
+           "Future", "RequestTimeout", "ServingQueueFull"]

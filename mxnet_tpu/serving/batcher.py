@@ -1,0 +1,263 @@
+"""DynamicBatcher — micro-batching request queue in front of a
+ServingEngine.
+
+Clipper-style adaptive batching: concurrent callers `submit()` small
+request batches (usually 1 row); a single worker thread coalesces
+whatever is queued — up to `max_batch` rows, waiting at most
+`max_wait_us` after the first request of a batch for stragglers — and
+runs ONE compiled-plan execution for the whole coalesced batch. Under
+load the wait never happens (the queue is already deep when the worker
+comes back from the device), so throughput rides the biggest bucket
+while lightly-loaded latency stays within `max_wait_us` of raw engine
+latency.
+
+Overload protocol (the load-shedding / backpressure contract):
+  - the queue is bounded at `queue_depth` requests: `submit()` on a full
+    queue raises `ServingQueueFull` immediately (shed at the door — the
+    caller can retry/back off; nothing is silently dropped once
+    accepted);
+  - every request carries a deadline (`timeout_ms`, default
+    `default_timeout_ms`); a request whose deadline passed while queued
+    fails with `RequestTimeout` when the worker reaches it, and never
+    occupies device time. `Future.result()` applies the same deadline
+    client-side as a backstop.
+
+All outcomes (completed / shed / timeout / error), per-request latency,
+batch-size histogram and live queue depth are recorded in a
+`ServingMetrics` (metrics.py), reachable as `batcher.metrics`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .metrics import ServingMetrics
+
+
+class ServingQueueFull(RuntimeError):
+    """submit() on a full queue — shed; back off and retry."""
+
+
+class RequestTimeout(TimeoutError):
+    """The request's deadline expired before it reached the device."""
+
+
+class Future:
+    """Minimal completion handle (threading.Event based)."""
+
+    __slots__ = ("_ev", "_value", "_exc", "_deadline")
+
+    def __init__(self, deadline):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+        self._deadline = deadline
+
+    def _set(self, value):
+        self._value = value
+        self._ev.set()
+
+    def _set_exception(self, exc):
+        self._exc = exc
+        self._ev.set()
+
+    def done(self):
+        return self._ev.is_set()
+
+    def result(self, timeout=None):
+        if timeout is None and self._deadline is not None:
+            # backstop: never block past the request's own deadline
+            timeout = max(self._deadline - time.monotonic(), 0.0) + 1.0
+        if not self._ev.wait(timeout):
+            raise RequestTimeout("result() timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "t_submit", "deadline", "future")
+
+    def __init__(self, arrays, rows, deadline):
+        self.arrays = arrays
+        self.rows = rows
+        self.t_submit = time.monotonic()
+        self.deadline = deadline
+        self.future = Future(deadline)
+
+
+class DynamicBatcher:
+    """Coalesce concurrent requests into bucketed engine executions.
+
+    Parameters
+    ----------
+    engine : object with `max_batch`, `input_names` and
+        `infer(*arrays) -> [np.ndarray]` (normally a ServingEngine).
+    max_batch : rows per coalesced execution; defaults to (and may not
+        exceed) `engine.max_batch`.
+    max_wait_us : how long the worker lingers for stragglers after the
+        first request of a batch. 0 = never wait (pure greedy drain).
+    queue_depth : bound on QUEUED requests; submit() past it sheds.
+    default_timeout_ms : per-request deadline when submit() gives none;
+        None = no deadline.
+    """
+
+    def __init__(self, engine, max_batch=None, max_wait_us=2000,
+                 queue_depth=64, default_timeout_ms=None, metrics=None):
+        self.engine = engine
+        cap = int(getattr(engine, "max_batch", 0) or 0)
+        self.max_batch = int(max_batch or cap or 1)
+        if cap and self.max_batch > cap:
+            raise ValueError(f"max_batch {self.max_batch} exceeds the "
+                             f"engine's export batch {cap}")
+        self.max_wait_s = max_wait_us / 1e6
+        self.queue_depth = int(queue_depth)
+        self.default_timeout_ms = default_timeout_ms
+        self.metrics = metrics or ServingMetrics()
+        self._q = deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._worker = threading.Thread(target=self._loop,
+                                        name="mxnet_tpu-serving-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, *arrays, timeout_ms=None):
+        """Enqueue one request (rows <= max_batch, batch axis 0);
+        returns a Future. Raises ServingQueueFull when the bounded
+        queue is at capacity."""
+        if self._stopped:
+            raise RuntimeError("batcher is closed")
+        arrays = [np.asarray(getattr(a, "_data", a), np.float32)
+                  for a in arrays]
+        rows = int(arrays[0].shape[0]) if arrays and arrays[0].ndim else 1
+        if rows < 1 or rows > self.max_batch:
+            raise ValueError(f"request rows {rows} outside "
+                             f"[1, {self.max_batch}]")
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        req = _Request(arrays, rows, deadline)
+        with self._cond:
+            if len(self._q) >= self.queue_depth:
+                self.metrics.record_shed()
+                raise ServingQueueFull(
+                    f"queue at capacity ({self.queue_depth}); shedding")
+            self._q.append(req)
+            self.metrics.record_submit()
+            self.metrics.record_queue_depth(len(self._q))
+            self._cond.notify()
+        return req.future
+
+    def infer(self, *arrays, timeout_ms=None):
+        """Blocking convenience wrapper: submit + result."""
+        return self.submit(*arrays, timeout_ms=timeout_ms).result()
+
+    def close(self, drain=True):
+        """Stop the worker. With drain=True pending requests are served
+        first; otherwise they fail with RuntimeError."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            if not drain:
+                while self._q:
+                    req = self._q.popleft()
+                    req.future._set_exception(
+                        RuntimeError("batcher closed"))
+            self._cond.notify_all()
+        self._worker.join(timeout=30)
+
+    __enter__ = lambda self: self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- worker side --------------------------------------------------------
+
+    def _pop_expired(self, req, now):
+        """True (and fail the future) when req's deadline passed."""
+        if req.deadline is not None and now > req.deadline:
+            self.metrics.record_timeout()
+            req.future._set_exception(RequestTimeout(
+                f"deadline exceeded after "
+                f"{(now - req.t_submit) * 1e3:.1f} ms in queue"))
+            return True
+        return False
+
+    def _take_batch(self):
+        """Block until work (or stop); return the coalesced request
+        list, honoring max_batch rows and the max_wait_us linger."""
+        with self._cond:
+            while not self._q and not self._stopped:
+                self._cond.wait()
+            if not self._q:
+                return None                      # stopped and drained
+            batch, rows = [], 0
+            t_first = time.monotonic()
+            linger_until = t_first + self.max_wait_s
+            while True:
+                now = time.monotonic()
+                while self._q:
+                    req = self._q[0]
+                    if self._pop_expired(req, now):
+                        self._q.popleft()
+                        continue
+                    if rows + req.rows > self.max_batch:
+                        break
+                    self._q.popleft()
+                    batch.append(req)
+                    rows += req.rows
+                    if rows == self.max_batch:
+                        break
+                remaining = linger_until - now
+                if rows >= self.max_batch or remaining <= 0 \
+                        or self._stopped:
+                    break
+                if not batch and not self._q:
+                    # everything seen so far expired; wait fresh
+                    t_first = time.monotonic()
+                    linger_until = t_first + self.max_wait_s
+                    self._cond.wait()
+                    if self._stopped and not self._q:
+                        return None
+                    continue
+                self._cond.wait(timeout=remaining)
+            self.metrics.record_queue_depth(len(self._q))
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            arrays = [np.concatenate([r.arrays[i] for r in batch], axis=0)
+                      for i in range(len(batch[0].arrays))] \
+                if len(batch) > 1 else list(batch[0].arrays)
+            rows = sum(r.rows for r in batch)
+            try:
+                outs = self.engine.infer(*arrays)
+            except Exception as e:
+                for r in batch:
+                    self.metrics.record_error()
+                    r.future._set_exception(e)
+                continue
+            self.metrics.record_batch(rows)
+            now = time.monotonic()
+            off = 0
+            for r in batch:
+                sl = [o[off:off + r.rows]
+                      if getattr(o, "ndim", 0) and o.shape[0] == rows
+                      else o for o in outs]
+                off += r.rows
+                self.metrics.record_done(now - r.t_submit)
+                r.future._set(sl)

@@ -1,0 +1,183 @@
+"""ServingEngine — bucketed compiled-plan cache over an exported .mxa.
+
+The inference artifact binds ONE batch shape at export time (the
+MXPredCreate contract, contrib/export.py). Under serving load the
+request batch is whatever the micro-batcher coalesced this tick — and
+XLA recompiles per shape, so naively executing each distinct batch size
+would either thrash the compile cache or waste the MXU padding
+everything to the export batch on the host.
+
+The engine takes the middle path the serving literature converged on
+(Clipper-style adaptive batching over fixed-shape accelerators):
+
+  - a ladder of power-of-two batch *buckets* up to the export batch
+    (read from MANIFEST.json's `serving` block when present, derived
+    otherwise);
+  - one compiled plan per bucket, built lazily and cached: a jitted
+    program that zero-pads the bucket batch up to the export batch ON
+    DEVICE, calls the exported StableHLO module, and slices outputs back
+    to the bucket — pad and slice are fused into the XLA program, so the
+    host only ever pads request->bucket (cheap numpy);
+  - `warmup()` pre-compiles every bucket so no request pays a compile.
+
+Thread-safe: plan creation and device execution are serialized with an
+internal lock (one device stream; the DynamicBatcher drives it from a
+single worker thread anyway, but direct `infer` from many threads is
+safe too).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..predictor import Predictor
+
+
+def _pow2_buckets(max_batch):
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_batch))
+    return buckets
+
+
+class ServingEngine:
+    """Load a .mxa artifact (or wrap an existing Predictor) and serve
+    any request batch <= the export batch through bucketed compiled
+    plans."""
+
+    def __init__(self, model, device=None, buckets=None, warmup=True):
+        self._pred = model if isinstance(model, Predictor) \
+            else Predictor(model, device=device)
+        man = self._pred.manifest
+        serving = man.get("serving", {})
+        self.batch_axis = int(serving.get("batch_axis", 0))
+        if self.batch_axis != 0:
+            raise ValueError("ServingEngine: only batch_axis 0 artifacts "
+                             "are supported")
+        self.max_batch = self._pred.export_batch
+        ladder = buckets or serving.get("buckets") \
+            or _pow2_buckets(self.max_batch)
+        ladder = sorted({int(b) for b in ladder if 1 <= int(b)})
+        if any(b > self.max_batch for b in ladder):
+            raise ValueError(f"ServingEngine: bucket larger than the "
+                             f"export batch {self.max_batch}")
+        if not ladder or ladder[-1] != self.max_batch:
+            ladder.append(self.max_batch)
+        self.buckets = ladder
+        self.input_names = list(self._pred._input_names)
+        self.output_names = list(self._pred.output_names)
+        self._plans = {}
+        self._lock = threading.RLock()
+        self.plan_compiles = 0          # bucket plans built (cache misses)
+        self.executions = 0             # compiled-plan invocations
+        self.padded_rows = 0            # host-side request->bucket padding
+        if warmup:
+            self.warmup()
+
+    @classmethod
+    def from_symbol(cls, symbol, arg_params, aux_params, data_shapes,
+                    path=None, **kwargs):
+        """Export `symbol` through contrib.export and serve the artifact
+        — the one-call train->serve bridge (uses the same _build_runner
+        lowering the Executor runs)."""
+        import tempfile
+        import os
+        from ..contrib.export import export_model
+        if path is None:
+            path = os.path.join(tempfile.mkdtemp(prefix="mxa_serve_"),
+                                "model.mxa")
+        export_model(path, symbol, arg_params, aux_params, data_shapes)
+        return cls(path, **kwargs)
+
+    # -- plan cache ---------------------------------------------------------
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n (the plan that serves an n-row batch)."""
+        if n < 1 or n > self.max_batch:
+            raise ValueError(f"batch {n} outside [1, {self.max_batch}]")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_batch          # unreachable (ladder ends at max)
+
+    def _plan(self, bucket):
+        plan = self._plans.get(bucket)
+        if plan is not None:
+            return plan
+        import jax
+        import jax.numpy as jnp
+        exp = self._pred._exp
+        B = self.max_batch
+
+        def fn(inputs, state, rng):
+            feed = []
+            for x in inputs:
+                if x.ndim > 0 and x.shape[0] == bucket and bucket < B:
+                    pad = jnp.zeros((B - bucket,) + x.shape[1:], x.dtype)
+                    x = jnp.concatenate([x, pad], axis=0)
+                feed.append(x)
+            outs = exp.call(*feed, *state, rng)
+            return tuple(o[:bucket]
+                         if getattr(o, "ndim", 0) and o.shape[0] == B
+                         else o for o in outs)
+
+        plan = jax.jit(fn)
+        self._plans[bucket] = plan
+        self.plan_compiles += 1
+        return plan
+
+    def warmup(self):
+        """Compile every bucket plan up front (serving must not pay XLA
+        compiles on the request path)."""
+        with self._lock:
+            for b in self.buckets:
+                zeros = [np.zeros((b,) + tuple(
+                    self._pred._input_shapes[n][1:]), np.float32)
+                    for n in self.input_names]
+                self._run(b, zeros)
+
+    # -- request path -------------------------------------------------------
+
+    def _run(self, bucket, arrays):
+        plan = self._plan(bucket)
+        outs = plan(tuple(arrays), tuple(self._pred._state),
+                    self._pred._rng)
+        self.executions += 1
+        return outs
+
+    def infer(self, *arrays):
+        """Run one already-coalesced batch (n rows, 1 <= n <= max_batch,
+        batch axis 0). Returns a list of numpy arrays sliced to n."""
+        arrays = [np.asarray(getattr(a, "_data", a), np.float32)
+                  for a in arrays]
+        if len(arrays) != len(self.input_names):
+            raise ValueError(f"expected {len(self.input_names)} inputs "
+                             f"{self.input_names}, got {len(arrays)}")
+        n = int(arrays[0].shape[0])
+        for name, a in zip(self.input_names, arrays):
+            want = self._pred._input_shapes[name]
+            if a.shape[0] != n or tuple(a.shape[1:]) != tuple(want[1:]):
+                raise ValueError(
+                    f"input {name!r}: shape {tuple(a.shape)} is not "
+                    f"(n<= {self.max_batch},)+{tuple(want[1:])}")
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            arrays = [np.concatenate(
+                [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)],
+                axis=0) for a in arrays]
+            self.padded_rows += bucket - n
+        with self._lock:
+            outs = self._run(bucket, arrays)
+        return [np.asarray(o)[:n]
+                if getattr(o, "ndim", 0) and np.asarray(o).shape[0] == bucket
+                else np.asarray(o) for o in outs]
+
+    def stats(self):
+        return {"buckets": list(self.buckets),
+                "max_batch": self.max_batch,
+                "plan_compiles": self.plan_compiles,
+                "executions": self.executions,
+                "padded_rows": self.padded_rows}

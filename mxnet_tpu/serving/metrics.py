@@ -1,0 +1,129 @@
+"""Serving counters — QPS, latency percentiles, batch histogram, queue
+depth, shed/timeout counts — wired into mx.profiler.
+
+Two consumption paths, same numbers:
+  - `snapshot()` / `to_json()` for the serving CLI and tools;
+  - every `ServingMetrics` registers itself as a profiler counter-export
+    hook (profiler.register_counter_export), so `mx.profiler.dump()`
+    embeds the serving counters in the chrome-trace JSON and
+    `mx.profiler.export_counters()` returns them live. Queue depth and
+    shed count additionally tick profiler `Counter` objects in a
+    "serving" `Domain`, which emits 'C' (counter) trace events on the
+    profiler timeline when profiling is on.
+
+Latency percentiles come from a bounded reservoir of the most recent
+`latency_window` request latencies (deque ring) — O(1) record, exact
+percentiles over the window, no unbounded growth under sustained load.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from .. import profiler
+
+
+class ServingMetrics:
+    """Thread-safe serving counters; one instance per batcher/engine."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, name="serving", latency_window=4096):
+        with ServingMetrics._seq_lock:
+            ServingMetrics._seq += 1
+            seq = ServingMetrics._seq
+        self.name = name if seq == 1 else f"{name}#{seq}"
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests = 0          # accepted submits
+        self.completed = 0         # futures resolved with a result
+        self.shed = 0              # rejected at submit (queue full)
+        self.timeouts = 0          # expired before execution
+        self.errors = 0            # engine raised; future got the error
+        self.batches = 0           # compiled-plan invocations
+        self.batched_rows = 0      # rows across all batches
+        self.queue_depth = 0       # live queue size (gauge)
+        self._batch_hist = {}      # rows -> count
+        self._lat = deque(maxlen=latency_window)
+        dom = profiler.Domain(self.name)
+        self._c_depth = dom.new_counter("queue_depth")
+        self._c_shed = dom.new_counter("shed_total")
+        profiler.register_counter_export(self.name, self.snapshot)
+
+    def close(self):
+        profiler.unregister_counter_export(self.name)
+
+    # -- recording ----------------------------------------------------------
+
+    def record_submit(self):
+        with self._lock:
+            self.requests += 1
+
+    def record_shed(self):
+        with self._lock:
+            self.shed += 1
+        self._c_shed.increment()
+
+    def record_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+
+    def record_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def record_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+        if profiler.is_running():
+            self._c_depth.set_value(depth)
+
+    def record_batch(self, rows):
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += rows
+            self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
+
+    def record_done(self, latency_s):
+        with self._lock:
+            self.completed += 1
+            self._lat.append(latency_s)
+
+    # -- reading ------------------------------------------------------------
+
+    def _percentile_ms(self, lat_sorted, p):
+        if not lat_sorted:
+            return None
+        i = min(len(lat_sorted) - 1,
+                int(round(p / 100.0 * (len(lat_sorted) - 1))))
+        return round(lat_sorted[i] * 1e3, 3)
+
+    def snapshot(self):
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            lat = sorted(self._lat)
+            return {
+                "requests": self.requests,
+                "completed": self.completed,
+                "shed": self.shed,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "batches": self.batches,
+                "batched_rows": self.batched_rows,
+                "avg_batch_rows": round(self.batched_rows
+                                        / self.batches, 3)
+                if self.batches else None,
+                "batch_hist": {str(k): v for k, v in
+                               sorted(self._batch_hist.items())},
+                "queue_depth": self.queue_depth,
+                "qps": round(self.completed / elapsed, 2),
+                "p50_ms": self._percentile_ms(lat, 50),
+                "p99_ms": self._percentile_ms(lat, 99),
+                "uptime_s": round(elapsed, 3),
+            }
+
+    def to_json(self):
+        return json.dumps(self.snapshot())

@@ -9,10 +9,20 @@ must override via jax.config here, before any backend is initialized.
 import os
 
 os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+# older jax releases have no jax_num_cpu_devices option at all — the
+# XLA flag is the portable spelling of "8 virtual CPU devices", and it
+# must be in place before the backend initializes
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:      # pre-jax_num_cpu_devices: XLA_FLAGS above
+    pass
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402

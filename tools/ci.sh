@@ -26,7 +26,10 @@ EOF
 
 case "$stage" in
   quick)
-    python -m pytest tests/ -m quick -q ;;
+    python -m pytest tests/ -m quick -q
+    echo "== serving smoke (dynamic-batching selftest, tiny convnet)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.serving --selftest --requests 128 ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)

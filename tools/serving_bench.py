@@ -1,0 +1,87 @@
+"""Serving latency/throughput sweep — closed-loop load over the
+DynamicBatcher (companion to `python -m mxnet_tpu.serving --selftest`,
+which is the single-point smoke; this sweeps the knobs).
+
+Grid: concurrency x max_wait_us. Each cell runs the closed-loop load
+generator from serving.__main__ (C client threads, single-row requests)
+and records qps, p50/p99 and the realized batch histogram; the
+sequential single-request Predictor rate is measured once as the
+baseline. Prints ONE JSON line:
+
+    {"metric": "serving_bench", "sequential_qps": ..., "sweep": [
+       {"concurrency": 8, "max_wait_us": 2000, "qps": ..., "speedup":
+        ..., "p50_ms": ..., "p99_ms": ..., "avg_batch_rows": ...}, ...]}
+
+Run: python tools/serving_bench.py [model.mxa] [--requests 256]
+     [--concurrency 1,2,4,8] [--max-wait-us 0,2000]
+Defaults to the built-in tiny convnet (no artifact needed) on whatever
+backend jax selects (set JAX_PLATFORMS=cpu for the host-only run).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="serving knob sweep")
+    ap.add_argument("model", nargs="?", default=None)
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--concurrency", default="1,2,4,8")
+    ap.add_argument("--max-wait-us", default="0,2000")
+    ap.add_argument("--queue-depth", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.serving import DynamicBatcher, ServingEngine
+    from mxnet_tpu.serving.__main__ import (_batched_qps,
+                                            _export_tiny_convnet,
+                                            _sequential_qps)
+
+    path = args.model or _export_tiny_convnet()
+    eng = ServingEngine(path)
+    shape = tuple(eng._pred._input_shapes[eng.input_names[0]])
+    sample = np.random.RandomState(0) \
+        .uniform(0, 1, (1,) + shape[1:]).astype(np.float32)
+    seq_qps = _sequential_qps(path, sample, min(args.requests, 64))
+
+    sweep = []
+    for conc in [int(c) for c in args.concurrency.split(",")]:
+        for wait_us in [int(w) for w in args.max_wait_us.split(",")]:
+            with DynamicBatcher(eng, max_wait_us=wait_us,
+                                queue_depth=args.queue_depth) as bat:
+                qps = _batched_qps(bat, sample, args.requests, conc)
+                snap = bat.metrics.snapshot()
+            sweep.append({
+                "concurrency": conc,
+                "max_wait_us": wait_us,
+                "qps": round(qps, 2),
+                "speedup": round(qps / seq_qps, 2),
+                "p50_ms": snap["p50_ms"],
+                "p99_ms": snap["p99_ms"],
+                "avg_batch_rows": snap["avg_batch_rows"],
+                "batch_hist": snap["batch_hist"],
+                "shed": snap["shed"],
+                "timeouts": snap["timeouts"],
+            })
+    print(json.dumps({
+        "metric": "serving_bench",
+        "model": path,
+        "requests": args.requests,
+        "max_batch": eng.max_batch,
+        "buckets": eng.buckets,
+        "sequential_qps": round(seq_qps, 2),
+        "sweep": sweep,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
