@@ -58,4 +58,5 @@ from . import image
 from . import gluon
 from . import rnn
 from . import serving
+from . import pipeline
 from . import test_utils

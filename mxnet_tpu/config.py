@@ -60,6 +60,14 @@ _DOCUMENTED = {
     "MXNET_TPU_DISABLE_NATIVE_ITER": 0,
     "MXNET_TPU_NATIVE_DIR": None,
     "MXIO_PIPE_DEBUG": 0,
+    # async device-feed pipeline + persistent compile cache
+    # (docs/PIPELINE.md): MXNET_DEVICE_FEED=0 restores the synchronous
+    # per-step device_put path; MXNET_COMPILE_CACHE=<dir> points JAX's
+    # persistent XLA compilation cache at <dir> so executor bind, Gluon
+    # CachedOp and serving bucket plans hit disk on re-runs
+    "MXNET_DEVICE_FEED": 1,
+    "MXNET_DEVICE_FEED_DEPTH": 2,
+    "MXNET_COMPILE_CACHE": None,
 }
 
 
@@ -91,11 +99,46 @@ def list_vars():
     return {k: get(k) for k in sorted(_DOCUMENTED)}
 
 
+def enable_compile_cache(path):
+    """Point JAX's persistent XLA compilation cache at `path` (creating
+    it), so every jit/bind in this process — executor programs, Gluon
+    CachedOp, serving bucket plans — is written to and re-loaded from
+    disk across process restarts. The min-compile-time/min-entry-size
+    thresholds are zeroed where the jax version has them, so small
+    programs cache too (the warm-vs-cold win is measured by bench.py's
+    compile_cache lane). Returns True when the cache was wired."""
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(opt, val)
+            except Exception:
+                pass    # older jax: threshold option absent
+        try:
+            # jax latches its cache handle at the first compile: if any
+            # program compiled before the dir was set, the cache sits
+            # initialized-with-no-dir and silently writes nothing —
+            # re-initialize so the new dir takes effect mid-process
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        return True
+    except Exception:
+        return False
+
+
 def _apply_startup():
     """Honor vars that have a live meaning (called at package import)."""
     from . import engine
     engine.set_engine_type(get("MXNET_ENGINE_TYPE"))
     engine.set_bulk_size(get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN"))
+    cache_dir = get("MXNET_COMPILE_CACHE")
+    if cache_dir:
+        enable_compile_cache(cache_dir)
     if get("MXNET_PROFILER_AUTOSTART"):
         from . import profiler
         profiler.set_state("run")

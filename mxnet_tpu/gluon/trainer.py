@@ -117,23 +117,39 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
             if n in pmap:
                 pmap[n].set_data(nd_array(np.asarray(a)))
 
+    from ..pipeline import feed_or_inline, close_feed
+
+    def _blocks(stream):
+        while True:
+            block = list(itertools.islice(stream, k))
+            if not block:
+                return
+            yield block
+
+    def _stage_block(block):
+        # stack + device commit on the feeder thread: block N+1 is staged
+        # while block N's fused scan executes (np.stack copies, so loader
+        # buffer reuse is safe)
+        xs = np.stack([_np_of(b[0]) for b in block])
+        ys = np.stack([_np_of(b[1]) for b in block])
+        return trainer.shard_inputs([xs, ys], stacked=True), len(block)
+
     k = int(steps_per_dispatch)
     epoch_losses = []
     for epoch in range(num_epoch):
         total, count = 0.0, 0
         stream = itertools.chain([first], it) if epoch == 0 \
             else iter(train_data)
-        while True:
-            block = list(itertools.islice(stream, k))
-            if not block:
-                break
-            xs = np.stack([_np_of(b[0]) for b in block])
-            ys = np.stack([_np_of(b[1]) for b in block])
-            inputs = trainer.shard_inputs([xs, ys], stacked=True)
-            params, states, aux, losses, _ = trainer.step_k(
-                params, states, aux, inputs)
-            total += float(np.sum(np.asarray(losses)))
-            count += len(block) * batch
+        feed = feed_or_inline(_blocks(stream), _stage_block,
+                              name="gluon_fused_fit")
+        try:
+            for inputs, n_blk in feed:
+                params, states, aux, losses, _ = trainer.step_k(
+                    params, states, aux, inputs)
+                total += float(np.sum(np.asarray(losses)))
+                count += n_blk * batch
+        finally:
+            close_feed(feed)
         if count == 0:
             # a single-pass generator exhausts after epoch 0 — failing
             # loudly beats recording 0.0-loss "epochs" that trained nothing

@@ -84,19 +84,29 @@ class BaseModule:
 
     def _eval_batches(self, eval_data, num_batch, reset):
         """Shared eval-iteration core for score/predict: (index, batch,
-        unpadded outputs) triples after an inference forward."""
+        unpadded outputs) triples after an inference forward. Batch N+1
+        is staged onto device by the async device feed (pipeline.py)
+        while batch N's forward runs; staging copies out of the iterator's
+        buffers, so prefetching ahead is safe even for buffer-reusing
+        iterators."""
+        from ..pipeline import feed_or_inline, close_feed, module_stage
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
         batches = eval_data if num_batch is None \
             else itertools.islice(eval_data, num_batch)
-        for i, batch in enumerate(batches):
-            self.forward(batch, is_train=False)
-            outs = self.get_outputs()
-            if batch.pad:
-                # iterator tail-padding: drop the replicated rows
-                outs = [o[:o.shape[0] - batch.pad] for o in outs]
-            yield i, batch, outs
+        feed = feed_or_inline(batches, module_stage(self),
+                              name="module_eval")
+        try:
+            for i, batch in enumerate(feed):
+                self.forward(batch, is_train=False)
+                outs = self.get_outputs()
+                if batch.pad:
+                    # iterator tail-padding: drop the replicated rows
+                    outs = [o[:o.shape[0] - batch.pad] for o in outs]
+                yield i, batch, outs
+        finally:
+            close_feed(feed)
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -112,14 +122,22 @@ class BaseModule:
         count = 0
         batches = eval_data if num_batch is None \
             else itertools.islice(eval_data, num_batch)
-        for nbatch, eval_batch in enumerate(batches):
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            for callback in callbacks:
-                callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals()))
-            count = nbatch + 1
+        # stage batch N+1 onto device while batch N's forward runs
+        # (pipeline.DeviceFeed; MXNET_DEVICE_FEED=0 restores sync feed)
+        from ..pipeline import feed_or_inline, close_feed, module_stage
+        feed = feed_or_inline(batches, module_stage(self),
+                              name="module_score")
+        try:
+            for nbatch, eval_batch in enumerate(feed):
+                self.forward(eval_batch, is_train=False)
+                self.update_metric(eval_metric, eval_batch.label)
+                for callback in callbacks:
+                    callback(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals()))
+                count = nbatch + 1
+        finally:
+            close_feed(feed)
         for callback in _as_list(score_end_callback):
             callback(BatchEndParam(epoch=epoch, nbatch=count,
                                    eval_metric=eval_metric, locals=locals()))
@@ -210,38 +228,50 @@ class BaseModule:
         batch_callbacks = _as_list(batch_end_callback)
         epoch_callbacks = _as_list(epoch_end_callback)
 
+        from ..pipeline import feed_or_inline, close_feed, module_stage
+
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
             eval_metric.reset()
-            data_iter = iter(train_data)
             # iterator contract: a DataBatch is only guaranteed valid until
-            # the next next() call (legacy buffer-reusing iterators), so
-            # batch N+1 is fetched only AFTER batch N's forward/update
+            # the next next() call (legacy buffer-reusing iterators) — the
+            # sync path honors it by fetching batch N+1 only AFTER batch
+            # N's forward/update; the device feed honors it by COPYING
+            # each batch onto device at prefetch time (pipeline.py), and
+            # stages batch N+1 while step N executes
+            data_iter = feed_or_inline(iter(train_data), module_stage(self),
+                                       name="module_fit")
             data_batch = next(data_iter, None)
             nbatch = 0
-            while data_batch is not None:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                upcoming = next(data_iter, None)
-                if upcoming is not None:
-                    # hand the next batch to the prefetch hook while this
-                    # step's arrays are still settling (async dispatch)
-                    self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                # contract: callbacks fire AFTER the metric update and see
-                # the loop state through `locals` (Speedometer & friends)
-                if batch_callbacks:
-                    cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                             eval_metric=eval_metric,
-                                             locals=locals())
-                    for callback in batch_callbacks:
-                        callback(cb_param)
-                data_batch = upcoming
-                nbatch += 1
+            try:
+                while data_batch is not None:
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    upcoming = next(data_iter, None)
+                    if upcoming is not None:
+                        # hand the next batch to the prefetch hook while
+                        # this step's arrays are still settling (async
+                        # dispatch)
+                        self.prepare(upcoming,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    # contract: callbacks fire AFTER the metric update and
+                    # see the loop state through `locals` (Speedometer &
+                    # friends)
+                    if batch_callbacks:
+                        cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                                 eval_metric=eval_metric,
+                                                 locals=locals())
+                        for callback in batch_callbacks:
+                            callback(cb_param)
+                    data_batch = upcoming
+                    nbatch += 1
+            finally:
+                close_feed(data_iter)
 
             # log-format contract: "Epoch[N] Train-<metric>=<val>" lines
             for name, val in eval_metric.get_name_value():

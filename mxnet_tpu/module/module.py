@@ -424,50 +424,66 @@ class Module(BaseModule):
             aux_params=self._aux_params)
 
         from ..base import to_numpy as _np_of
+        from ..pipeline import feed_or_inline, close_feed
         data_idx = {n: i for i, n in enumerate(self._data_names)}
         label_idx = {n: i for i, n in enumerate(self._label_names)}
+
+        def _blocks(data_iter):
+            while True:
+                block = list(itertools.islice(data_iter, k))
+                if not block:
+                    return
+                yield block
+
+        def _stage_block(block):
+            # host stack + device commit run on the feeder thread: block
+            # N+1 is staged while block N's fused scan executes. np.stack
+            # copies, so iterator buffer reuse is safe; a short tail block
+            # compiles its own (cached) k'-step scan
+            stacked = []
+            for name in trainer.input_names:
+                if name in data_idx:
+                    col = [_np_of(b.data[data_idx[name]])
+                           for b in block]
+                else:
+                    col = [_np_of(b.label[label_idx[name]])
+                           for b in block]
+                stacked.append(np.stack(col))
+            inputs = trainer.shard_inputs(stacked, stacked=True)
+            labels = {
+                name: np.concatenate([_np_of(b.label[i]) for b in block])
+                for name, i in label_idx.items()}
+            return inputs, labels, len(block)
 
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
             eval_metric.reset()
-            data_iter = iter(train_data)
             nbatch = 0
-            while True:
-                block = list(itertools.islice(data_iter, k))
-                if not block:
-                    break
-                # a short tail block compiles its own (cached) k'-step scan
-                stacked = []
-                for name in trainer.input_names:
-                    if name in data_idx:
-                        col = [_np_of(b.data[data_idx[name]])
-                               for b in block]
-                    else:
-                        col = [_np_of(b.label[label_idx[name]])
-                               for b in block]
-                    stacked.append(np.stack(col))
-                inputs = trainer.shard_inputs(stacked, stacked=True)
-                params, states, aux, losses, outputs = trainer.step_k(
-                    params, states, aux, inputs, outputs_mode="all")
-                # metric over ALL K batches at once: flatten the scan axis
-                # into the batch axis (same samples K=1 would feed one by
-                # one, one update call instead of K)
-                pred_dict = {
-                    name: NDArray(o.reshape((-1,) + o.shape[2:]))
-                    for name, o in zip(self._output_names, outputs)}
-                label_dict = {
-                    name: NDArray(
-                        np.concatenate(
-                            [_np_of(b.label[i]) for b in block]))
-                    for name, i in label_idx.items()}
-                eval_metric.update_dict(label_dict, pred_dict)
-                nbatch += len(block)
-                if batch_callbacks:
-                    cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
-                                             eval_metric=eval_metric,
-                                             locals=locals())
-                    for callback in batch_callbacks:
-                        callback(cb_param)
+            feed = feed_or_inline(_blocks(iter(train_data)), _stage_block,
+                                  name="module_fit_fused")
+            try:
+                for inputs, label_np, n_blk in feed:
+                    params, states, aux, losses, outputs = trainer.step_k(
+                        params, states, aux, inputs, outputs_mode="all")
+                    # metric over ALL K batches at once: flatten the scan
+                    # axis into the batch axis (same samples K=1 would feed
+                    # one by one, one update call instead of K)
+                    pred_dict = {
+                        name: NDArray(o.reshape((-1,) + o.shape[2:]))
+                        for name, o in zip(self._output_names, outputs)}
+                    label_dict = {name: NDArray(v)
+                                  for name, v in label_np.items()}
+                    eval_metric.update_dict(label_dict, pred_dict)
+                    nbatch += n_blk
+                    if batch_callbacks:
+                        cb_param = BatchEndParam(epoch=epoch,
+                                                 nbatch=nbatch - 1,
+                                                 eval_metric=eval_metric,
+                                                 locals=locals())
+                        for callback in batch_callbacks:
+                            callback(cb_param)
+            finally:
+                close_feed(feed)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

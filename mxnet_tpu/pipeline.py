@@ -1,0 +1,352 @@
+"""Async device-feed pipeline — hide host-side input cost behind compute.
+
+The reference hides input cost behind compute twice over: iter_prefetcher.h
+double-buffers batches on the host and the dependency engine overlaps the
+host->device copy lane (kCopyToGPU) with kernels (SURVEY §1 rows 2/7).
+io.PrefetchingIter reproduces the first half; this module is the device
+boundary's half: `DeviceFeed` runs a background feeder thread that pulls
+batch N+1 from the source iterator and *stages* it — commits it to the
+device (jax.device_put with the consumer's sharding, parallel/mesh.py) —
+while step N executes on the device. The consumer loop then finds its
+next batch already resident and its per-step device_put collapses to a
+no-op (device_put on a committed array with the same sharding returns it
+unchanged, so results are bit-identical to the synchronous path).
+
+Mechanics:
+  - bounded ring (depth 2 by default, MXNET_DEVICE_FEED_DEPTH): the
+    feeder stays at most `depth` batches ahead, so device memory holds a
+    bounded number of staged batches no matter how fast the source is;
+  - the stage function runs ON THE FEEDER THREAD and must copy out of
+    the source item (device_put / np.stack both do), which is what makes
+    prefetching safe over legacy buffer-reusing iterators — the very
+    reason BaseModule.fit's fetch-after-update discipline exists;
+  - feeder exceptions are re-raised in the consumer thread at the next
+    __next__; close() drains and joins the thread (no leaked threads);
+  - counters (`feed_wait_us`, `feed_stage_us`, `overlap_frac`, ...) are
+    exported through profiler.register_counter_export under the
+    "device_feed" key, so profiler.dump() traces carry them.
+
+The loops threaded through it: Module/BaseModule.fit, the fused K-step
+drivers (Module._fit_fused, gluon.trainer.fused_fit), BaseModule.score /
+predict, and ServingEngine.warmup. `MXNET_DEVICE_FEED=0` restores the
+fully synchronous path everywhere (the bench.py `pipeline` lane measures
+the two against each other).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["DeviceFeed", "module_stage", "enabled", "default_depth",
+           "stats", "reset_stats"]
+
+# -- aggregate counters (exported via profiler.register_counter_export) -----
+
+_STATS_LOCK = threading.Lock()
+_TOTALS = {"feed_wait_us": 0, "feed_stage_us": 0, "feed_batches": 0,
+           "feeds_opened": 0, "feeds_closed": 0}
+
+
+def _bump(key, val):
+    with _STATS_LOCK:
+        _TOTALS[key] += val
+
+
+def stats():
+    """Snapshot of the aggregate device-feed counters. `overlap_frac` is
+    the fraction of staging time hidden behind compute: 1 when consumers
+    never blocked on the feed, 0 when every staged microsecond was waited
+    for (fully serial)."""
+    with _STATS_LOCK:
+        out = dict(_TOTALS)
+    stage = out["feed_stage_us"]
+    out["overlap_frac"] = round(
+        max(0.0, 1.0 - out["feed_wait_us"] / stage), 4) if stage else 0.0
+    return out
+
+
+def reset_stats():
+    with _STATS_LOCK:
+        for k in _TOTALS:
+            _TOTALS[k] = 0
+
+
+def _register_export():
+    from . import profiler
+    profiler.register_counter_export("device_feed", stats)
+
+
+_register_export()
+
+
+# -- config knobs ------------------------------------------------------------
+
+def enabled():
+    """MXNET_DEVICE_FEED gate (default on; 0 restores synchronous feed)."""
+    from . import config
+    return bool(config.get("MXNET_DEVICE_FEED", 1))
+
+
+def default_depth():
+    from . import config
+    return max(1, int(config.get("MXNET_DEVICE_FEED_DEPTH", 2)))
+
+
+# -- the prefetcher ----------------------------------------------------------
+
+_END = "end"
+_ITEM = "item"
+_ERR = "err"
+
+
+class DeviceFeed:
+    """Iterate `source` with staging one batch ahead on a feeder thread.
+
+    `stage(item)` runs on the feeder thread and should return the
+    device-committed form of `item` (it MUST copy out of any buffer the
+    source reuses; jax.device_put and np.stack both do). Omitting it
+    degrades gracefully to host-side prefetch of the raw items.
+
+    Iterator contract: yields staged items in source order; StopIteration
+    at exhaustion; a feeder-side exception (from the source or the stage
+    fn) is re-raised here, in the consumer thread. Use as a context
+    manager or call close() — close is idempotent, drains the ring, and
+    joins the thread.
+    """
+
+    def __init__(self, source, stage=None, depth=None, name="device_feed"):
+        self._source = iter(source)
+        self._stage = stage if stage is not None else (lambda item: item)
+        self._depth = depth if depth is not None else default_depth()
+        self._q = queue.Queue(maxsize=max(1, int(self._depth)))
+        self._stop = threading.Event()
+        self._done = False
+        self.name = name
+        # per-instance counters (module totals aggregate across feeds)
+        self.wait_us = 0
+        self.stage_us = 0
+        self.batches = 0
+        _bump("feeds_opened", 1)
+        self._thread = threading.Thread(
+            target=self._feeder, name=f"{name}-feeder", daemon=True)
+        self._thread.start()
+
+    # -- feeder side --------------------------------------------------------
+    def _put(self, msg):
+        """Bounded put that gives up when the consumer closed the feed."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _feeder(self):
+        # feed_stage_us is the full feeder-side cost per item — source
+        # pull plus staging — i.e. exactly the host work the feed hides.
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    break
+                staged = self._stage(item)
+                dt_us = int((time.perf_counter() - t0) * 1e6)
+                self.stage_us += dt_us
+                _bump("feed_stage_us", dt_us)
+                if not self._put((_ITEM, staged)):
+                    return
+            self._put((_END, None))
+        except BaseException as exc:   # noqa: BLE001 — re-raised consumer-side
+            self._put((_ERR, exc))
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        kind, val = self._q.get()
+        dt_us = int((time.perf_counter() - t0) * 1e6)
+        self.wait_us += dt_us
+        _bump("feed_wait_us", dt_us)
+        if kind == _ITEM:
+            self.batches += 1
+            _bump("feed_batches", 1)
+            return val
+        self._done = True
+        self.close()
+        if kind == _ERR:
+            raise val
+        raise StopIteration
+
+    def overlap_frac(self):
+        """Fraction of this feed's staging time hidden behind compute."""
+        if not self.stage_us:
+            return 0.0
+        return max(0.0, 1.0 - self.wait_us / self.stage_us)
+
+    def close(self):
+        """Stop the feeder, drain the ring, join the thread. Idempotent."""
+        if self._stop.is_set() and not self._thread.is_alive():
+            return
+        self._stop.set()
+        # drain so a feeder blocked in put() wakes and sees the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=10.0)
+        self._done = True
+        _bump("feeds_closed", 1)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# -- stage builders ----------------------------------------------------------
+
+def module_stage(module):
+    """Stage function for DataBatch streams feeding a bound module: each
+    data/label array is committed to the placement the module's executor
+    will request in forward — batch-sharded inputs / per-context device
+    (executor._arg_sharding) — so forward's own device_put is a no-op.
+
+    Placement is resolved per batch through `module._exec` (rebind /
+    reshape swap the executor mid-fit). Arrays whose batch axis doesn't
+    divide the mesh are passed through unstaged so forward raises its
+    documented divisibility error instead of a feeder-thread jax error;
+    modules without a bound executor degrade to host-side prefetch.
+    """
+    import jax
+    from .io import DataBatch
+    from .ndarray.ndarray import NDArray
+
+    def _put(ex, name, arr):
+        if name not in ex.arg_dict:
+            return arr
+        data = arr._data if isinstance(arr, NDArray) else arr
+        if not isinstance(data, jax.Array):
+            data = np.asarray(data)
+        if ex._mesh is not None:
+            if name in ex._sharded_args and data.shape and \
+                    data.shape[0] % ex._mesh.devices.size != 0:
+                return arr      # forward owns the divisibility error
+            target = ex._arg_sharding(name)
+        else:
+            target = ex._ctx.jax_device()
+        return NDArray(jax.device_put(data, target))
+
+    def stage(batch):
+        ex = getattr(module, "_exec", None)
+        if ex is None or getattr(ex, "arg_dict", None) is None:
+            return batch
+        data = [_put(ex, n, a)
+                for n, a in zip(module.data_names, batch.data)]
+        label = batch.label
+        if label:
+            lnames = list(getattr(module, "label_names", None) or [])
+            label = [_put(ex, n, a) for n, a in zip(lnames, label)]
+        return DataBatch(data=data, label=label, pad=batch.pad,
+                         index=batch.index, bucket_key=batch.bucket_key,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    return stage
+
+
+def feed_or_inline(source, stage, name="device_feed"):
+    """DeviceFeed when MXNET_DEVICE_FEED is on, else a lazy synchronous
+    map of the SAME stage function — consumer loops get one code path
+    whose math is identical either way (only the thread differs)."""
+    if enabled():
+        return DeviceFeed(source, stage=stage, name=name)
+    return map(stage, source)
+
+
+def close_feed(feed):
+    """close() for DeviceFeed, no-op for the inline map fallback."""
+    if isinstance(feed, DeviceFeed):
+        feed.close()
+
+
+# -- smoke entry (tools/ci.sh quick stage) -----------------------------------
+
+def _selftest():
+    """Overlap smoke: a source with real per-item host cost feeding a
+    consumer with real per-item compute; asserts order + values survive
+    the feed, the feeder thread exits, and staging actually overlapped."""
+    import os
+    import jax
+
+    n, host_ms = 24, 4.0
+
+    def source():
+        for i in range(n):
+            time.sleep(host_ms / 1e3)        # decode/read stand-in
+            yield i, np.full((64,), i, np.float32)
+
+    dev = jax.devices()[0]
+
+    def stage(item):
+        i, arr = item
+        return i, jax.device_put(arr, dev)
+
+    t0 = time.perf_counter()
+    seen = []
+    with DeviceFeed(source(), stage=stage, name="selftest") as feed:
+        for i, arr in feed:
+            time.sleep(host_ms / 1e3)        # device-step stand-in
+            assert float(np.asarray(arr)[0]) == float(i)
+            seen.append(i)
+        thread = feed._thread
+    wall = time.perf_counter() - t0
+    assert seen == list(range(n)), "order not preserved"
+    assert not thread.is_alive(), "feeder thread leaked"
+    sync_est = 2 * n * host_ms / 1e3
+    print(f"device-feed selftest: {n} items, wall {wall:.2f}s vs "
+          f"~{sync_est:.2f}s synchronous, overlap_frac "
+          f"{stats()['overlap_frac']}")
+    if wall >= sync_est * 0.85:
+        raise SystemExit("selftest FAILED: no overlap measured")
+    print("PIPELINE-SELFTEST-OK")
+
+
+def main(argv=None):
+    import argparse
+    import os
+    ap = argparse.ArgumentParser(description="async device-feed pipeline")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    # the site hook may pin jax_platforms at interpreter start, overriding
+    # the JAX_PLATFORMS env this smoke is launched with (ci.sh quick) —
+    # re-pin via jax.config before the first backend touch
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    if args.selftest:
+        _selftest()
+        return 0
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

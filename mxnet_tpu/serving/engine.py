@@ -131,13 +131,30 @@ class ServingEngine:
 
     def warmup(self):
         """Compile every bucket plan up front (serving must not pay XLA
-        compiles on the request path)."""
-        with self._lock:
-            for b in self.buckets:
-                zeros = [np.zeros((b,) + tuple(
-                    self._pred._input_shapes[n][1:]), np.float32)
-                    for n in self.input_names]
-                self._run(b, zeros)
+        compiles on the request path). Bucket b+1's dummy inputs are
+        built on the async device feed's thread (pipeline.py) while
+        bucket b compiles; with MXNET_COMPILE_CACHE set, re-runs load
+        every bucket plan from the disk cache instead of recompiling.
+
+        The dummies stay host-side numpy on purpose: requests arrive as
+        numpy, and jit's executable fast path keys on input commitment —
+        warming with device-committed arrays would leave the first real
+        request paying a fresh compile."""
+        from ..pipeline import feed_or_inline, close_feed
+
+        def _stage(b):
+            return b, [np.zeros((b,) + tuple(
+                self._pred._input_shapes[n][1:]), np.float32)
+                for n in self.input_names]
+
+        feed = feed_or_inline(iter(self.buckets), _stage,
+                              name="serving_warmup")
+        try:
+            with self._lock:
+                for b, staged in feed:
+                    self._run(b, staged)
+        finally:
+            close_feed(feed)
 
     # -- request path -------------------------------------------------------
 

@@ -66,6 +66,8 @@ _QUICK = {
     "test_sparse_optimizer.py::test_sgd_lazy_update_touches_only_grad_rows",
     "test_image.py::test_crops_and_normalize",
     "test_profiler.py::test_print_summary",
+    "test_pipeline.py::test_feed_order_values_and_shutdown",
+    "test_pipeline.py::test_module_fit_bit_identical_with_feed",
 }
 
 

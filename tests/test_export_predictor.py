@@ -90,6 +90,11 @@ def test_predictor_is_standalone(tmp_path):
 
     import mxnet_tpu.predictor as predictor_mod
     script = textwrap.dedent(f"""
+        import jax
+        # a site hook may pin jax_platforms at interpreter start, which
+        # overrides the JAX_PLATFORMS env on this child — re-pin before
+        # the first backend touch or the child hangs probing devices
+        jax.config.update("jax_platforms", "cpu")
         import importlib.util, sys
         import numpy as np
         spec = importlib.util.spec_from_file_location(

@@ -96,8 +96,11 @@ def test_native_2bit_matches_python():
     os.environ["MXNET_TPU_DISABLE_NATIVE"] = "1"
     try:
         code = (
+            "import jax\n"
+            # env var is too late if a site hook pinned jax_platforms at
+            # interpreter start — re-pin via jax.config instead
+            "jax.config.update('jax_platforms', 'cpu')\n"
             "import numpy as np, os\n"
-            "os.environ['JAX_PLATFORMS']='cpu'\n"
             "from mxnet_tpu import kvstore as kvs\n"
             "import sys\n"
             "arr = np.load(sys.argv[1])['arr']\n"

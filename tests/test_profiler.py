@@ -104,6 +104,11 @@ def test_env_config_surface():
 
 def test_naive_engine_env():
     code = (
+        # re-pin the platform via jax.config: a site hook may set
+        # jax_platforms at interpreter start, overriding JAX_PLATFORMS
+        # env in this child (the child would hang probing devices)
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "import numpy as np, mxnet_tpu as mx\n"
         "from mxnet_tpu import engine\n"
         "assert engine._sync_mode\n"

@@ -29,7 +29,10 @@ case "$stage" in
     python -m pytest tests/ -m quick -q
     echo "== serving smoke (dynamic-batching selftest, tiny convnet)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-      python -m mxnet_tpu.serving --selftest --requests 128 ;;
+      python -m mxnet_tpu.serving --selftest --requests 128
+    echo "== device-feed smoke (async pipeline overlap selftest)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.pipeline --selftest ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)

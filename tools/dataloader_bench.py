@@ -65,12 +65,30 @@ class NumpyHeavy:
         return a.astype(np.float32), np.float32(i % 10)
 
 
-def run(ds, batch, workers, worker_type):
+def run(ds, batch, workers, worker_type, device_feed=False):
     from mxnet_tpu.gluon.data import DataLoader
     dl = DataLoader(ds, batch_size=batch, shuffle=False,
                     num_workers=workers, worker_type=worker_type)
     for _ in dl:        # warm (spawns pools, pages data)
         break
+    if device_feed:
+        # stage each batch onto device on the feeder thread — the loader
+        # handles host-side collation, the DeviceFeed hides the
+        # host->device boundary (the consumer finds batches resident)
+        import jax
+        from mxnet_tpu.pipeline import DeviceFeed
+        dev = jax.devices()[0]
+
+        def stage(b):
+            return tuple(jax.device_put(np.asarray(
+                getattr(a, "_data", a)), dev) for a in b)
+
+        t0 = time.perf_counter()
+        n = 0
+        with DeviceFeed(iter(dl), stage=stage, name="dl_bench") as feed:
+            for b in feed:
+                n += int(b[0].shape[0])
+        return n / (time.perf_counter() - t0)
     t0 = time.perf_counter()
     n = 0
     for b in dl:
@@ -83,6 +101,9 @@ def main():
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--device-feed", action="store_true",
+                    help="also time each config with DeviceFeed staging "
+                         "batches onto the device (mxnet_tpu.pipeline)")
     a = ap.parse_args()
     print(f"host cores: {os.cpu_count()}")
     for name, ds in (("gil-bound", GilBound(a.n)),
@@ -93,6 +114,11 @@ def main():
         print(f"{name:12s}: inline {r0:8.0f}/s  "
               f"threads({a.workers}) {rt:8.0f}/s  "
               f"procs({a.workers}) {rp:8.0f}/s")
+        if a.device_feed:
+            f0 = run(ds, a.batch, 0, "thread", device_feed=True)
+            ft = run(ds, a.batch, a.workers, "thread", device_feed=True)
+            print(f"{'':12s}  +device-feed: inline {f0:8.0f}/s  "
+                  f"threads({a.workers}) {ft:8.0f}/s")
 
 
 if __name__ == "__main__":
