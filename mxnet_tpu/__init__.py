@@ -49,6 +49,7 @@ from . import visualization as viz
 from . import config
 from . import operator
 from . import rtc
+from . import amp
 config._apply_startup()
 from .monitor import Monitor
 from . import module

@@ -1,0 +1,81 @@
+"""Autocast policy: which registry ops run in half precision.
+
+Role of the reference's AMP op lists (python/mxnet/contrib/amp/lists/
+symbol_fp16.py: FP16_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS), keyed on
+OUR op registry names (ops/registry.py). Three buckets:
+
+  ALLOW  — matmul/conv-class ops whose FLOPs dominate step time and whose
+           MXU rate doubles in bf16/fp16: float inputs are cast DOWN to
+           the amp dtype at the use site. Accumulation stays fp32 inside
+           the kernels (dot_general preferred_element_type, the flash-
+           attention VMEM accumulators, conv1x1's fp32 psum), so only
+           storage/bandwidth and the MXU input width narrow.
+  WIDEN  — numerically fragile reductions: softmax family, loss heads,
+           and every normalization whose statistics must accumulate in
+           fp32 (the Micikevicius et al. 2018 recipe). Float inputs are
+           cast UP to fp32, so a bf16 activation entering softmax is
+           widened and the exp/sum runs full width.
+  (rest) — NEUTRAL: elementwise/shape ops run in whatever dtype arrives;
+           casting them would only add convert traffic. Integer inputs
+           (embedding ids, argmax indices) are never touched by any
+           bucket — bf16's 8-bit mantissa corrupts ids (parallel/dp.py
+           learned this the hard way).
+
+The lists are module-level frozensets so tests and docs/AMP.md can
+introspect them; `amp.init` does not mutate them.
+"""
+from __future__ import annotations
+
+# compute-bound ops: cast float inputs down to the amp dtype
+ALLOW = frozenset({
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+    "_linalg_gemm",
+    "_linalg_gemm2",
+    "_contrib_flash_attention",
+})
+
+# legacy loss-head ops whose custom VJP supplies its own gradient and
+# IGNORES the incoming cotangent (the MXNet out_grad=False contract:
+# ops/nn.py returns e.g. (softmax - onehot) * grad_scale regardless of
+# what flows in from above). Multiplying the loss by the fp16 loss scale
+# therefore does NOT scale gradients under these heads — the scale must
+# be injected into the cotangent directly BELOW the head instead
+# (amp.cast_op_inputs wraps the head's data input in a custom_vjp that
+# multiplies the outgoing cotangent by the live scale). Graphs whose
+# loss is an ordinary differentiable value keep the textbook
+# `loss * scale` route in parallel/dp.py; the two mechanisms are
+# mutually exclusive by construction (scaling the loss above a
+# cotangent-ignoring head is a no-op, and injection only fires on the
+# ops listed here).
+LOSS_HEADS = frozenset({
+    "SoftmaxOutput",
+    "LinearRegressionOutput",
+    "LogisticRegressionOutput",
+    "MAERegressionOutput",
+    "MakeLoss",
+    "SVMOutput",
+})
+
+# reduction/loss/norm ops: cast float inputs up to fp32
+WIDEN = frozenset({
+    "softmax",
+    "log_softmax",
+    "SoftmaxActivation",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "L2Normalization",
+    "LRN",
+    "norm",
+    "MakeLoss",
+    "make_loss",
+    "SVMOutput",
+    "smooth_l1",
+    "IdentityAttachKLSparseReg",
+})

@@ -1,0 +1,106 @@
+"""DynamicLossScaler — fp16 gradient-underflow protection.
+
+fp16's 5-bit exponent bottoms out at ~6e-8: small-magnitude gradients
+silently flush to zero, so fp16 training multiplies the loss by a large
+scale before backprop (shifting every gradient up into representable
+range), unscales before the update, and *skips* any step whose scaled
+gradients overflowed to inf/nan (Micikevicius et al. 2018 §3.2; the
+reference ships this as contrib/amp's LossScaler). bfloat16 keeps
+fp32's 8-bit exponent and needs none of this — see docs/AMP.md.
+
+Two usage shapes:
+
+  - Host-driven (gluon / custom loops): the class below — scale the
+    loss, check the grads, call `update(overflow)` each step.
+  - Trace-driven (the fused DataParallelTrainer step): the scaler state
+    is a 3-vector ``[scale, good_steps, skipped_total]`` carried on
+    device through the jitted step (and through the lax.scan carry for
+    step_k), updated by `update_state` inside the trace so k fused steps
+    grow/backoff exactly like k python-dispatched steps.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+
+class DynamicLossScaler:
+    """Grow-on-success / backoff-on-overflow loss scale.
+
+    scale starts at `init_scale`; every `growth_interval` consecutive
+    finite steps it multiplies by `growth_factor` (capped at
+    `max_scale`); any non-finite gradient halves it by `backoff_factor`
+    (floored at `min_scale`) and the step is skipped.
+    """
+
+    def __init__(self, init_scale=2.0 ** 15, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000,
+                 min_scale=1.0, max_scale=2.0 ** 24):
+        if init_scale <= 0:
+            raise ValueError("DynamicLossScaler: init_scale must be > 0")
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.scale = self.init_scale
+        self.good_steps = 0
+        self.skipped_steps = 0
+
+    # -- host-driven API ----------------------------------------------------
+
+    def scale_loss(self, loss):
+        return loss * self.scale
+
+    def unscale(self, grads):
+        inv = 1.0 / self.scale
+        return [g * inv for g in grads]
+
+    def has_overflow(self, grads):
+        for g in grads:
+            a = _np.asarray(getattr(g, "_data", g), dtype=_np.float32)
+            if not _np.all(_np.isfinite(a)):
+                return True
+        return False
+
+    def update(self, overflow):
+        """Advance the schedule after one step; returns True when the
+        step should be APPLIED (i.e. no overflow)."""
+        if overflow:
+            self.scale = max(self.scale * self.backoff_factor,
+                             self.min_scale)
+            self.good_steps = 0
+            self.skipped_steps += 1
+            return False
+        self.good_steps += 1
+        if self.good_steps >= self.growth_interval:
+            self.scale = min(self.scale * self.growth_factor,
+                             self.max_scale)
+            self.good_steps = 0
+        return True
+
+    # -- trace-driven API (fused step / scan carry) -------------------------
+
+    def state0(self):
+        """Initial on-device state vector [scale, good, skipped] (f32)."""
+        return _np.array([self.scale, float(self.good_steps),
+                          float(self.skipped_steps)], _np.float32)
+
+    def update_state(self, state, finite):
+        """Pure jax-traceable schedule update: `state` is the 3-vector,
+        `finite` a boolean scalar (all grads finite). Returns the new
+        state vector; constants fold into the trace."""
+        import jax.numpy as jnp
+        scale, good, skipped = state[0], state[1], state[2]
+        good = jnp.where(finite, good + 1.0, 0.0)
+        grow = good >= float(self.growth_interval)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow,
+                      jnp.minimum(scale * self.growth_factor,
+                                  self.max_scale),
+                      scale),
+            jnp.maximum(scale * self.backoff_factor, self.min_scale))
+        good = jnp.where(grow, 0.0, good)
+        skipped = skipped + jnp.where(finite, 0.0, 1.0)
+        return jnp.stack([new_scale, good, skipped])

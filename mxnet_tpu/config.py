@@ -68,6 +68,13 @@ _DOCUMENTED = {
     "MXNET_DEVICE_FEED": 1,
     "MXNET_DEVICE_FEED_DEPTH": 2,
     "MXNET_COMPILE_CACHE": None,
+    # mixed precision (mxnet_tpu.amp, docs/AMP.md): MXNET_AMP=1 turns on
+    # framework-wide autocast at import; MXNET_AMP_DTYPE picks the
+    # compute dtype — bfloat16 (default, no loss scaling needed) or
+    # float16 (DynamicLossScaler engages in the fused dp step). Unset /
+    # MXNET_AMP=0 leaves every program bit-identical to fp32.
+    "MXNET_AMP": 0,
+    "MXNET_AMP_DTYPE": "bfloat16",
 }
 
 
@@ -139,6 +146,9 @@ def _apply_startup():
     cache_dir = get("MXNET_COMPILE_CACHE")
     if cache_dir:
         enable_compile_cache(cache_dir)
+    if get("MXNET_AMP"):
+        from . import amp
+        amp.init(get("MXNET_AMP_DTYPE") or "bfloat16")
     if get("MXNET_PROFILER_AUTOSTART"):
         from . import profiler
         profiler.set_state("run")

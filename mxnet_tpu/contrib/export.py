@@ -163,8 +163,13 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     serving_meta = None
     if len(batch_sizes) == 1:
         max_batch = batch_sizes.pop()
+        # amp_dtype records the COMPUTE dtype baked into the StableHLO
+        # module; request/response I/O stays fp32 regardless (the casts
+        # live inside `fn` above, so serving's bucket plans fuse them
+        # into each jitted pad->call->slice program)
         serving_meta = {"batch_axis": 0, "max_batch": max_batch,
-                        "buckets": serving_buckets(max_batch)}
+                        "buckets": serving_buckets(max_batch),
+                        "amp_dtype": dtype}
     manifest = {
         "format_version": FORMAT_VERSION,
         "inputs": [{"name": n, "shape": list(data_shapes[n]),

@@ -69,18 +69,28 @@ def init_process_group(coordinator_address=None, num_processes=None,
     return True
 
 
-def allreduce_sum(values):
+def allreduce_sum(values, reduce_dtype=None):
     """Sum a host-local numpy/jax array across all processes.
 
     CPU hosts ride Gloo; TPU pods ride ICI/DCN — jax picks the transport.
     This is the explicit-push path only; sharded training steps get their
     cross-process psum fused into the compiled program instead.
+
+    `reduce_dtype` (mxnet_tpu.amp): cast values to a half dtype BEFORE
+    the gather so the wire moves half-width words, then accumulate the
+    sum in fp32 and return fp32 — the kvstore push feeds the fp32 master
+    update, so only the transport narrows, never the accumulation.
     """
+    import numpy as np
     import jax
     if jax.process_count() == 1:
         return values
     from jax.experimental import multihost_utils
+    if reduce_dtype is not None:
+        values = np.asarray(values).astype(reduce_dtype)
     gathered = _local_value(multihost_utils.process_allgather(values))
+    if reduce_dtype is not None:
+        return gathered.astype(np.float32).sum(axis=0)
     return gathered.sum(axis=0)
 
 

@@ -148,6 +148,14 @@ def _build_runner(symbol, is_train, platform=None):
     from . import config as _config
     do_mirror = is_train and bool(_config.get("MXNET_BACKWARD_DO_MIRROR"))
 
+    # mxnet_tpu.amp autocast: every execution route (bind, Module.fit,
+    # CachedOp, DataParallelTrainer, export) lowers through this runner,
+    # so casting op inputs here per the ALLOW/WIDEN policy mixes
+    # precision framework-wide. Identity when amp is off — the traced
+    # program is unchanged, keeping fp32 results bit-identical. The amp
+    # state is read at TRACE time: flip amp.init before binding.
+    from . import amp as _amp
+
     # count rng consumers for key splitting
     rng_nodes = [id(n) for n in topo
                  if n.op is not None and n.op.needs_rng]
@@ -178,6 +186,11 @@ def _build_runner(symbol, is_train, platform=None):
             if id(node) in dead_bias:
                 parsed["__bias_grad_dead__"] = True
             ins = [vals[node_pos[id(n2)]][i2] for (n2, i2) in node.inputs]
+            # unconditional: besides the policy casts, this hook injects
+            # the fp16 loss scale into loss-head cotangents whenever a
+            # trace scale is set — which happens with amp globally off
+            # too (DataParallelTrainer(dtype="float16") standalone)
+            ins = _amp.cast_op_inputs(node.op.name, ins)
             key = keys[rng_slot[id(node)]] if id(node) in rng_slot else None
             octx = OpCtx(is_train=is_train, rng=key, platform=platform)
             if do_mirror:

@@ -29,7 +29,7 @@ __all__ = ["Trainer", "fused_fit"]
 
 def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
               optimizer_params=None, steps_per_dispatch=8, contexts=None,
-              dtype="float32", epoch_callback=None):
+              dtype=None, epoch_callback=None):
     """K-steps-per-dispatch training driver for gluon nets
     (steps_per_dispatch, beyond-reference; Module.fit's equivalent knob).
 
@@ -64,6 +64,11 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
     contexts = contexts or [current_context()]
     if not isinstance(contexts, (list, tuple)):
         contexts = [contexts]
+    if dtype is None:
+        # unspecified dtype follows the process-wide autocast policy
+        # (amp.init / MXNET_AMP); an explicit dtype= always wins
+        from .. import amp as _amp
+        dtype = _amp.get_dtype() if _amp.is_enabled() else "float32"
 
     it = iter(train_data)
     try:
@@ -213,6 +218,12 @@ class Trainer:
             self._optimizer = optimizer
             self._optimizer.param_dict = param_dict
         else:
+            from .. import amp as _amp
+            if _amp.is_enabled():
+                # half-precision weights need fp32 masters; amp turns them
+                # on by default (an explicit multi_precision=False wins)
+                optimizer_params = dict(optimizer_params)
+                optimizer_params.setdefault("multi_precision", True)
             self._optimizer = opt.create(optimizer, param_dict=param_dict,
                                          **optimizer_params)
         self._updaters = [opt.get_updater(self._optimizer)

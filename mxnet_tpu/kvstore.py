@@ -127,10 +127,15 @@ class KVStore:
                 merged = self._compress(k, merged)
             if self._dist:
                 # cross-process sum: sync parameter-server aggregation
-                # (kvstore_dist_server.h ApplyUpdates :282) as a collective
+                # (kvstore_dist_server.h ApplyUpdates :282) as a collective.
+                # With amp on, gradients cross the wire in bf16 and the
+                # sum accumulates in fp32 (half the push bytes; the
+                # updater's master state stays full precision)
+                from . import amp as _amp
                 from . import dist
                 from .ndarray.ndarray import array as nd_array
-                summed = dist.allreduce_sum(merged.asnumpy())
+                summed = dist.allreduce_sum(
+                    merged.asnumpy(), reduce_dtype=_amp.reduce_dtype())
                 merged = nd_array(summed, ctx=merged.context)
             stored = self._store[k]
             if self._updater is not None:

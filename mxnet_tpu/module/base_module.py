@@ -191,6 +191,17 @@ class BaseModule:
         monitor/state/fixed-param features; anything else falls back to
         K=1 with a warning."""
         assert num_epoch is not None, "please specify number of epochs"
+        from .. import amp as _amp
+        if _amp.is_enabled():
+            logging.info("AMP enabled: training casts matmul-class ops to "
+                         "%s (fp32 master weights)", _amp.get_dtype())
+            if _amp.get_dtype() == "float16" and not (
+                    steps_per_dispatch and steps_per_dispatch > 1):
+                logging.warning(
+                    "AMP float16: the per-batch fit path runs WITHOUT "
+                    "dynamic loss scaling — use steps_per_dispatch>1 "
+                    "(the fused trainer carries the DynamicLossScaler "
+                    "state on device) or expect underflowed gradients")
         if steps_per_dispatch and steps_per_dispatch > 1:
             handled = self._fit_fused(
                 train_data, eval_data=eval_data, eval_metric=eval_metric,

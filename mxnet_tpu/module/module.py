@@ -374,8 +374,11 @@ class Module(BaseModule):
             op_entry = _OPT_OPS[optimizer]
             opname = op_entry({"momentum": opt_params.get("momentum")}) \
                 if callable(op_entry) else op_entry
+            # multi_precision is handled, not a blocker: the fused path
+            # ALWAYS keeps fp32 master params (init_state seeds fp32 and
+            # the update runs fp32), so the flag is simply satisfied
             handled = {"learning_rate", "momentum", "wd", "rescale_grad",
-                       "clip_gradient"}
+                       "clip_gradient", "multi_precision"}
             extra = [k for k in opt_params
                      if k not in handled and k not in get_op(opname).params]
             if extra:
@@ -406,6 +409,12 @@ class Module(BaseModule):
 
         batch_size = self._data_shapes[0].shape[0]
         lr = float(opt_params.pop("learning_rate", 0.01))
+        opt_params.pop("multi_precision", None)   # always on (fp32 masters)
+        # amp threads the compute dtype into the fused scan: params stay
+        # fp32 masters, compute/grad-all-reduce run in the amp dtype, and
+        # for fp16 the DynamicLossScaler state rides the scan carry
+        from .. import amp as _amp
+        fit_dtype = _amp.get_dtype() if _amp.is_enabled() else "float32"
         trainer = DataParallelTrainer(
             self._symbol, mesh_for_contexts(self._context),
             data_names=tuple(self._data_names),
@@ -416,6 +425,7 @@ class Module(BaseModule):
             rescale_grad=float(opt_params.pop("rescale_grad",
                                               1.0 / batch_size)),
             clip_gradient=opt_params.pop("clip_gradient", None),
+            dtype=fit_dtype,
             **opt_params)
         shape_kwargs = {d.name: d.shape for d in
                         self._data_shapes + (self._label_shapes or [])}
@@ -538,6 +548,12 @@ class Module(BaseModule):
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
                 optimizer_params["rescale_grad"] = rescale_grad
+            # amp default: half-dtype weights get fp32 master copies in
+            # the updater (multi_precision only engages on fp16/bf16
+            # weights, so this is a no-op for fp32 training)
+            from .. import amp as _amp
+            if _amp.is_enabled():
+                optimizer_params.setdefault("multi_precision", True)
             optimizer = opt_mod.create(optimizer, sym=self.symbol,
                                        param_idx2name=idx2name,
                                        **optimizer_params)

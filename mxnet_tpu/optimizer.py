@@ -16,10 +16,18 @@ import pickle
 
 import numpy
 
-from .base import MXNetError
+from .base import MXNetError, bfloat16 as _bfloat16
 from .ndarray import ndarray as nd
 from .ndarray.ndarray import NDArray, zeros
 from . import ndarray as ndns
+
+
+def _needs_master_copy(dtype):
+    """True for the half dtypes whose weights need an fp32 master copy
+    under multi_precision: float16 (the reference's only case) and
+    bfloat16 (mxnet_tpu.amp — same 8-bit mantissa problem: repeated
+    small updates round to nothing when accumulated in half)."""
+    return dtype == numpy.float16 or dtype == _bfloat16
 
 __all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
            "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
@@ -82,23 +90,25 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        """fp16 weights get an fp32 master copy as leading state
-        (reference multi_precision, optimizer.py:201-223)."""
+        """fp16/bf16 weights get an fp32 master copy as leading state
+        (reference multi_precision, optimizer.py:201-223; bf16 extension
+        via mxnet_tpu.amp)."""
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _needs_master_copy(weight.dtype):
             weight_master_copy = weight.astype(numpy.float32)
             return (weight_master_copy,) + (self.create_state(index, weight_master_copy),)
-        if weight.dtype == numpy.float16 and not self.multi_precision:
-            logging.warning("Accumulating with float16 in optimizer can lead "
+        if _needs_master_copy(weight.dtype) and not self.multi_precision:
+            logging.warning("Accumulating with %s in optimizer can lead "
                             "to poor accuracy or slow convergence. Consider "
-                            "using multi_precision=True option of the optimizer")
+                            "using multi_precision=True option of the "
+                            "optimizer", weight.dtype)
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _needs_master_copy(weight.dtype):
             weight_master_copy = state[0]
             original_state = state[1]
             grad32 = grad.astype(numpy.float32)
@@ -208,15 +218,15 @@ class SGD(Optimizer):
 
     def create_state_multi_precision(self, index, weight):
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _needs_master_copy(weight.dtype):
             weight_master_copy = weight.astype(numpy.float32)
             return (self.create_state(index, weight_master_copy),
                     weight_master_copy)
-        if weight.dtype == numpy.float16 and not self.multi_precision:
-            logging.warning("Accumulating with float16 in optimizer can lead "
+        if _needs_master_copy(weight.dtype) and not self.multi_precision:
+            logging.warning("Accumulating with %s in optimizer can lead "
                             "to poor accuracy or slow convergence. Consider "
                             "using multi_precision=True option of the SGD "
-                            "optimizer")
+                            "optimizer", weight.dtype)
         return self.create_state(index, weight)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
@@ -270,7 +280,7 @@ class SGD(Optimizer):
 
     def update_multi_precision(self, index, weight, grad, state):
         use_multi_precision = self.multi_precision and \
-            weight.dtype == numpy.float16
+            _needs_master_copy(weight.dtype)
         self._update_impl(index, weight, grad, state,
                           multi_precision=use_multi_precision)
 

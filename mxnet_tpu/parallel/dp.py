@@ -47,7 +47,7 @@ class DataParallelTrainer:
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
                  clip_gradient=None, loss_index=0, dtype="float32",
-                 input_preproc=None, **opt_kwargs):
+                 input_preproc=None, loss_scaler=None, **opt_kwargs):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..ops.registry import get_op, AttrDict, OpCtx
 
@@ -69,14 +69,35 @@ class DataParallelTrainer:
         self._rng_dev = None
         self._lr_dev = None
         self._t_dev = None
-        if dtype not in ("float32", "bfloat16"):
-            raise MXNetError("DataParallelTrainer dtype must be float32 or "
-                             "bfloat16")
-        # bf16 = multi-precision training (reference optimizer
+        if dtype not in ("float32", "bfloat16", "float16"):
+            raise MXNetError("DataParallelTrainer dtype must be float32, "
+                             "bfloat16 or float16")
+        # half precision = multi-precision training (reference optimizer
         # multi_precision, SURVEY §7 hard-part 5): fp32 master params/aux,
-        # compute + activations in bfloat16, grads upcast before the fused
-        # fp32 update. ~1.7x step throughput on v5e for ResNet-50.
+        # compute + activations + the gradient all-reduce in the half
+        # dtype, grads upcast into the fused fp32 update. ~1.7x step
+        # throughput on v5e for ResNet-50, and the half-width all-reduce
+        # halves the wire bytes of the collective-bound dp step
+        # (MULTICHIP_r05: 5.9ms -> 28.3ms from 1 -> 8 devices was one
+        # sync fp32 all-reduce).
         self._compute_bf16 = dtype == "bfloat16"
+        self._dtype = dtype
+        compute_dtype = {"float32": None, "bfloat16": jnp.bfloat16,
+                         "float16": jnp.float16}[dtype]
+        self._compute_dtype = compute_dtype
+        # fp16's 5-bit exponent flushes small grads to zero and overflows
+        # large activations: wire in dynamic loss scaling (amp/scaler.py)
+        # with non-finite step skip. bf16 keeps fp32's exponent range and
+        # needs none of this (docs/AMP.md).
+        self._has_ls = dtype == "float16"
+        if self._has_ls and loss_scaler is None:
+            from ..amp.scaler import DynamicLossScaler
+            loss_scaler = DynamicLossScaler()
+        self._scaler = loss_scaler if self._has_ls else None
+        self._ls_dev = None
+        if self._has_ls:
+            from .. import amp as _amp
+            _amp._register_scale_source(self)
 
         hp = dict(opt_kwargs)
         if momentum:
@@ -113,7 +134,9 @@ class DataParallelTrainer:
         fcompute = schema.fcompute
         has_t = "t" in schema.params
         is_adam = optimizer == "adam"
-        compute_bf16 = self._compute_bf16
+        compute_dtype = self._compute_dtype
+        has_ls = self._has_ls
+        scaler = self._scaler
         data_name_set = frozenset(data_names)
         cast_input = [arg_names[p] in data_name_set for p in input_pos]
         # input_preproc(name, value) -> value runs INSIDE the compiled
@@ -123,41 +146,78 @@ class DataParallelTrainer:
         # first conv's input chain
         preproc_names = [arg_names[p] for p in input_pos]
 
-        def step(params, states, aux, inputs, rng, lr, t):
+        def _step_impl(params, states, aux, inputs, rng, lr, t, ls):
             # rng and t are device-carried: split/increment INSIDE the
             # compiled step so the host never dispatches a per-step key
             # split or scalar transfer (through a remote PJRT tunnel each
             # of those is a serializing round-trip)
             rng, next_rng = jax.random.split(rng)
-            t = t + 1.0
+            scale = ls[0] if has_ls else None
 
-            def loss_fn(params):
+            # params are cast to the compute dtype OUTSIDE loss_fn and
+            # differentiated AT the cast values: grad dtype == primal
+            # dtype, so the batch-axis psum XLA inserts reduces
+            # HALF-WIDTH words over ICI (the bf16 all-reduce). The fp32
+            # upcast in the update below is the exact transpose of the
+            # cast, so the update sees the same values as differentiating
+            # the fp32 masters directly — only the all-reduce narrows.
+            cparams = params if compute_dtype is None else tuple(
+                jnp.asarray(v, compute_dtype) for v in params)
+
+            def loss_fn(cparams):
                 args = [None] * n_args
-                for p, v in zip(param_pos, params):
-                    args[p] = jnp.asarray(v, jnp.bfloat16) \
-                        if compute_bf16 else v
+                for p, v in zip(param_pos, cparams):
+                    args[p] = v
                 for p, v, cast, nm in zip(input_pos, inputs, cast_input,
                                           preproc_names):
                     if input_preproc is not None:
                         v = input_preproc(nm, v)
                     # only FLOAT inputs cast: integer data (embedding token
-                    # ids) would be corrupted by bf16's 8-bit mantissa
-                    args[p] = jnp.asarray(v, jnp.bfloat16) \
-                        if compute_bf16 and cast and \
+                    # ids) would be corrupted by the half dtype's mantissa
+                    args[p] = jnp.asarray(v, compute_dtype) \
+                        if compute_dtype is not None and cast and \
                         jnp.issubdtype(v.dtype, jnp.floating) else v
                 # aux (BN running stats) stays fp32: _batch_norm casts at
                 # use sites, and the EMA update must accumulate in fp32 —
-                # a bf16 round-trip would quantize the running stats
+                # a half round-trip would quantize the running stats
                 outputs, new_aux = run(tuple(args), aux, rng)
                 # summing the (custom-vjp) head over the sharded batch is
                 # what makes XLA insert the gradient psum over ICI
-                loss = outputs[loss_index].sum()
-                return loss.astype(jnp.float32), (new_aux, outputs)
+                loss = outputs[loss_index].sum().astype(jnp.float32)
+                # fp16: backprop the SCALED loss so small-magnitude grads
+                # stay representable; the unscaled loss rides has_aux.
+                # NOTE this only reaches the gradients when the loss is an
+                # ordinary differentiable value — the legacy loss heads
+                # (SoftmaxOutput & co) IGNORE the incoming cotangent, so
+                # for them the scale is injected below the head instead
+                # (amp.LOSS_HEADS + the trace scale set around this trace)
+                obj = loss * scale if has_ls else loss
+                return obj, (new_aux, outputs, loss)
 
-            # grads are already fp32: the bf16 input casts transpose back
-            # to the fp32 primal dtype
-            (loss, (new_aux, outputs)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            if has_ls:
+                from .. import amp as _amp
+                _amp._set_trace_loss_scale(scale)
+            try:
+                (_, (new_aux, outputs, loss)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(cparams)
+            finally:
+                if has_ls:
+                    from .. import amp as _amp
+                    _amp._set_trace_loss_scale(None)
+            if has_ls:
+                # overflow check on the SCALED half grads (post-psum):
+                # any inf/nan skips the whole update and backs the scale
+                # off (Micikevicius et al. 2018 §3.2)
+                finite = jnp.asarray(True)
+                for g in grads:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+                # a skipped step is not an update: t (Adam bias
+                # correction) advances only on applied steps
+                t = t + jnp.where(finite, 1.0, 0.0)
+                inv_scale = 1.0 / scale
+            else:
+                t = t + 1.0
             eff_lr = lr
             if is_adam:  # python Adam's bias correction (optimizer.py)
                 b1, b2 = attrs["beta1"], attrs["beta2"]
@@ -169,11 +229,44 @@ class DataParallelTrainer:
             octx = OpCtx(is_train=True)
             new_params, new_states = [], []
             for w, g, st in zip(params, grads, states):
+                # upcast into the fused fp32 master update; fp16 also
+                # unscales — in fp32, so an overflowed grad stays inf
+                # (detectable above) instead of wrapping
+                if g.dtype != jnp.float32:
+                    g = g.astype(jnp.float32)
+                if has_ls:
+                    g = g * inv_scale
                 res = fcompute(a2, octx, w, g, *st)
-                new_params.append(res[0])
-                new_states.append(tuple(res[1:]))
+                if has_ls:
+                    # skipped step: params/states stay bit-identical
+                    new_params.append(jnp.where(finite, res[0], w))
+                    new_states.append(tuple(
+                        jnp.where(finite, s, s0)
+                        for s, s0 in zip(res[1:], st)))
+                else:
+                    new_params.append(res[0])
+                    new_states.append(tuple(res[1:]))
+            if has_ls:
+                # an overflowed forward would poison BN running stats too
+                new_aux = tuple(jnp.where(finite, a, a0)
+                                for a, a0 in zip(new_aux, aux))
+                new_ls = scaler.update_state(ls, finite)
+                return (tuple(new_params), tuple(new_states), new_aux,
+                        loss, outputs, next_rng, t, new_ls)
             return (tuple(new_params), tuple(new_states), new_aux, loss,
                     outputs, next_rng, t)
+
+        # the loss-scaler state rides the step signature ONLY for fp16:
+        # fp32/bf16 keep the 7-arg step so existing lower()/cost-analysis
+        # call sites (bench.py, __graft_entry__) stay valid
+        if has_ls:
+            def step(params, states, aux, inputs, rng, lr, t, ls):
+                return _step_impl(params, states, aux, inputs, rng, lr,
+                                  t, ls)
+        else:
+            def step(params, states, aux, inputs, rng, lr, t):
+                return _step_impl(params, states, aux, inputs, rng, lr,
+                                  t, None)
 
         repl = NamedSharding(mesh, P())
         shard = NamedSharding(mesh, P(self._data_axis))
@@ -183,10 +276,13 @@ class DataParallelTrainer:
         self._repl, self._shard = repl, shard
         self._step_py = step
         self._multi = {}   # (k, outputs_mode) -> jitted K-step scan
+        ls_extra = (repl,) if has_ls else ()
         self._step = jax.jit(
             step,
-            in_shardings=(repl, repl, repl, shard, repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl, shard, repl, repl),
+            in_shardings=(repl, repl, repl, shard, repl, repl, repl)
+            + ls_extra,
+            out_shardings=(repl, repl, repl, repl, shard, repl, repl)
+            + ls_extra,
             donate_argnums=(0, 1))
 
     def _multi_step_fn(self, k, outputs_mode, unroll=False):
@@ -196,8 +292,9 @@ class DataParallelTrainer:
         granularity: through a remote PJRT tunnel each python dispatch
         costs ~1-8 ms, so amortizing it over K steps is worth up to 4x on
         small-step models (measured on the LSTM LM lane, docs/ROUND4.md).
-        rng and the step counter are carried on-device across the scan, so
-        K fused steps are bit-identical to K python-dispatched steps."""
+        rng, the step counter and (fp16) the loss-scaler state are carried
+        on-device across the scan, so K fused steps are bit-identical to K
+        python-dispatched steps — including grow/backoff/skip decisions."""
         # True==1 as a dict key but lax.scan treats them differently
         # (True = full unroll, 1 = rolled): normalize True to "full"
         key = (int(k), outputs_mode,
@@ -206,31 +303,52 @@ class DataParallelTrainer:
         if fn is not None:
             return fn
         step = self._step_py
+        unroll_arg = True if key[2] == "full" else key[2]
 
-        def multi(params, states, aux, inputs, rng, lr, t):
-            def body(carry, xs):
-                params, states, aux, rng, t = carry
-                params, states, aux, loss, outputs, rng, t = step(
-                    params, states, aux, xs, rng, lr, t)
-                ys = (loss, outputs) if outputs_mode == "all" else loss
-                return (params, states, aux, rng, t), ys
+        if self._has_ls:
+            def multi(params, states, aux, inputs, rng, lr, t, ls):
+                def body(carry, xs):
+                    params, states, aux, rng, t, ls = carry
+                    (params, states, aux, loss, outputs, rng, t,
+                     ls) = step(params, states, aux, xs, rng, lr, t, ls)
+                    ys = (loss, outputs) if outputs_mode == "all" else loss
+                    return (params, states, aux, rng, t, ls), ys
 
-            (params, states, aux, rng, t), ys = jax.lax.scan(
-                body, (params, states, aux, rng, t), inputs, length=key[0],
-                unroll=True if key[2] == "full" else key[2])
-            if outputs_mode == "all":
-                losses, outputs = ys
-            else:
-                losses, outputs = ys, ()
-            return params, states, aux, losses, outputs, rng, t
+                (params, states, aux, rng, t, ls), ys = jax.lax.scan(
+                    body, (params, states, aux, rng, t, ls), inputs,
+                    length=key[0], unroll=unroll_arg)
+                if outputs_mode == "all":
+                    losses, outputs = ys
+                else:
+                    losses, outputs = ys, ()
+                return params, states, aux, losses, outputs, rng, t, ls
+        else:
+            def multi(params, states, aux, inputs, rng, lr, t):
+                def body(carry, xs):
+                    params, states, aux, rng, t = carry
+                    params, states, aux, loss, outputs, rng, t = step(
+                        params, states, aux, xs, rng, lr, t)
+                    ys = (loss, outputs) if outputs_mode == "all" else loss
+                    return (params, states, aux, rng, t), ys
+
+                (params, states, aux, rng, t), ys = jax.lax.scan(
+                    body, (params, states, aux, rng, t), inputs,
+                    length=key[0], unroll=unroll_arg)
+                if outputs_mode == "all":
+                    losses, outputs = ys
+                else:
+                    losses, outputs = ys, ()
+                return params, states, aux, losses, outputs, rng, t
 
         repl, block = self._repl, self._block_shard
+        ls_extra = (repl,) if self._has_ls else ()
         fn = jax.jit(
             multi,
-            in_shardings=(repl, repl, repl, block, repl, repl, repl),
+            in_shardings=(repl, repl, repl, block, repl, repl, repl)
+            + ls_extra,
             out_shardings=(repl, repl, repl, repl,
                            block if outputs_mode == "all" else repl,
-                           repl, repl),
+                           repl, repl) + ls_extra,
             donate_argnums=(0, 1))
         self._multi[key] = fn
         return fn
@@ -328,7 +446,7 @@ class DataParallelTrainer:
             out.append(jax.device_put(a, self._repl))
         return tuple(out)
 
-    def step(self, params, states, aux, inputs, rng=None):
+    def _ensure_dev_state(self, rng):
         if rng is not None:
             # explicit key (tests/reproducibility): commit it to the mesh —
             # it may have been minted on the default backend
@@ -340,8 +458,42 @@ class DataParallelTrainer:
             self._lr_dev = jax.device_put(_np.float32(self._lr), self._repl)
         if self._t_dev is None:
             self._t_dev = jax.device_put(_np.float32(self._t), self._repl)
-        out = self._step(params, states, aux, inputs, self._rng_dev,
-                         self._lr_dev, self._t_dev)
+        if self._has_ls and self._ls_dev is None:
+            self._ls_dev = jax.device_put(self._scaler.state0(), self._repl)
+
+    @property
+    def loss_scale(self):
+        """Live fp16 loss scale (None when loss scaling is inactive).
+        Reads the device-carried scaler state, so it synchronizes."""
+        if not self._has_ls:
+            return None
+        if self._ls_dev is None:
+            return float(self._scaler.scale)
+        return float(_np.asarray(self._ls_dev)[0])
+
+    @property
+    def skipped_steps(self):
+        """Steps skipped on non-finite fp16 gradients so far."""
+        if not self._has_ls:
+            return 0
+        if self._ls_dev is None:
+            return int(self._scaler.skipped_steps)
+        return int(_np.asarray(self._ls_dev)[2])
+
+    def _amp_counters(self):
+        """amp counter-export hook (amp.counters aggregates these)."""
+        return {"amp_scale": self.loss_scale,
+                "amp_skipped_steps": self.skipped_steps}
+
+    def step(self, params, states, aux, inputs, rng=None):
+        self._ensure_dev_state(rng)
+        if self._has_ls:
+            out = self._step(params, states, aux, inputs, self._rng_dev,
+                             self._lr_dev, self._t_dev, self._ls_dev)
+            self._ls_dev = out[7]
+        else:
+            out = self._step(params, states, aux, inputs, self._rng_dev,
+                             self._lr_dev, self._t_dev)
         # rng/t are device-carried (split/incremented inside the step): the
         # host never dispatches per-step key splits or scalar transfers
         self._rng_dev, self._t_dev = out[5], out[6]
@@ -370,18 +522,15 @@ class DataParallelTrainer:
         inner whiles run 3x slower under an outer rolled scan; unrolled
         they run at single-step device speed).
         """
-        if rng is not None:
-            self._rng_dev = jax.device_put(rng, self._repl)
-        elif self._rng_dev is None:
-            from .. import random as _random
-            self._rng_dev = jax.device_put(_random.next_key(), self._repl)
-        if self._lr_dev is None:
-            self._lr_dev = jax.device_put(_np.float32(self._lr), self._repl)
-        if self._t_dev is None:
-            self._t_dev = jax.device_put(_np.float32(self._t), self._repl)
+        self._ensure_dev_state(rng)
         k = int(inputs[0].shape[0])
         fn = self._multi_step_fn(k, outputs_mode, unroll)
-        out = fn(params, states, aux, inputs, self._rng_dev, self._lr_dev,
-                 self._t_dev)
+        if self._has_ls:
+            out = fn(params, states, aux, inputs, self._rng_dev,
+                     self._lr_dev, self._t_dev, self._ls_dev)
+            self._ls_dev = out[7]
+        else:
+            out = fn(params, states, aux, inputs, self._rng_dev,
+                     self._lr_dev, self._t_dev)
         self._rng_dev, self._t_dev = out[5], out[6]
         return out[:5]

@@ -170,10 +170,11 @@ def profile_op(name, run):
 
 
 # -- counter export hooks ---------------------------------------------------
-# Subsystems with their own live counters (e.g. mxnet_tpu.serving.metrics)
-# register a snapshot callable here; export_counters() merges every
-# registered snapshot into one dict, and dump() embeds it in the trace file
-# so a single profile JSON carries both the timeline and the counters.
+# Subsystems with their own live counters (e.g. mxnet_tpu.serving.metrics,
+# mxnet_tpu.amp's amp_scale/amp_skipped_steps/amp_cast_bytes_saved) register
+# a snapshot callable here; export_counters() merges every registered
+# snapshot into one dict, and dump() embeds it in the trace file so a single
+# profile JSON carries both the timeline and the counters.
 _counter_exports = {}
 
 

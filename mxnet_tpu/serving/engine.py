@@ -53,6 +53,11 @@ class ServingEngine:
             else Predictor(model, device=device)
         man = self._pred.manifest
         serving = man.get("serving", {})
+        # compute dtype baked into the artifact (mxnet_tpu.amp); request
+        # and response I/O are fp32 either way — the casts are fused
+        # inside each bucket's jitted plan (exp.call carries them)
+        self.amp_dtype = serving.get("amp_dtype") \
+            or man.get("dtype", "float32")
         self.batch_axis = int(serving.get("batch_axis", 0))
         if self.batch_axis != 0:
             raise ValueError("ServingEngine: only batch_axis 0 artifacts "
@@ -195,6 +200,7 @@ class ServingEngine:
     def stats(self):
         return {"buckets": list(self.buckets),
                 "max_batch": self.max_batch,
+                "amp_dtype": self.amp_dtype,
                 "plan_compiles": self.plan_compiles,
                 "executions": self.executions,
                 "padded_rows": self.padded_rows}

@@ -68,6 +68,8 @@ _QUICK = {
     "test_profiler.py::test_print_summary",
     "test_pipeline.py::test_feed_order_values_and_shutdown",
     "test_pipeline.py::test_module_fit_bit_identical_with_feed",
+    "test_amp.py::test_amp_bf16_mlp_converges_with_f32_masters",
+    "test_amp.py::test_fp16_scaler_skips_step_and_halves_scale",
 }
 
 

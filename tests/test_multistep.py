@@ -205,15 +205,27 @@ def test_gluon_fused_fit_learns():
 
 def test_module_fit_fused_fallback_unknown_hyperparam():
     """Optimizer hyperparams the fused op schema can't take (e.g.
-    multi_precision) fall back to K=1 instead of raising."""
+    begin_num_update) fall back to K=1 instead of raising, while
+    multi_precision is HANDLED by the fused path (fp32 masters are
+    always on there — mxnet_tpu.amp) and must not force a fallback."""
     it = _digits_iter(batch=32, n=64)
     mod = mx.mod.Module(_mlp(), context=mx.cpu(0))
     with _capture_warnings() as records:
         mod.fit(it, num_epoch=1, optimizer="sgd",
                 optimizer_params={"learning_rate": 0.1,
-                                  "multi_precision": True},
+                                  "begin_num_update": 0},
                 initializer=mx.init.Xavier(), steps_per_dispatch=4)
     assert any("falling back to per-batch" in r for r in records), records
+
+    it2 = _digits_iter(batch=32, n=64)
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    with _capture_warnings() as records2:
+        mod2.fit(it2, num_epoch=1, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1,
+                                   "multi_precision": True},
+                 initializer=mx.init.Xavier(), steps_per_dispatch=4)
+    assert not any("falling back to per-batch" in r for r in records2), \
+        records2
 
 
 def test_gluon_fused_fit_rejects_exhausted_generator():

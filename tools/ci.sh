@@ -32,7 +32,10 @@ case "$stage" in
       python -m mxnet_tpu.serving --selftest --requests 128
     echo "== device-feed smoke (async pipeline overlap selftest)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-      python -m mxnet_tpu.pipeline --selftest ;;
+      python -m mxnet_tpu.pipeline --selftest
+    echo "== amp smoke (autocast no-op / bf16 convergence / fp16 scaler)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.amp --selftest ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)
