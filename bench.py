@@ -831,6 +831,111 @@ def _amp_lane():
             "devices": n}
 
 
+def _checkpoint_lane():
+    """Checkpoint overhead A/B (mxnet_tpu.checkpoint, ISSUE 5): the amp
+    lane's MLP stepped with NO checkpoints, with SYNCHRONOUS full-state
+    commits every 8 steps, and with ASYNC (saver-thread) commits on the
+    same cadence — steps/s each, so the overhead the async design buys
+    back is on record — plus restore latency and bytes per commit. The
+    cadence is sized so ~8 steps of compute cover one serialize+fsync
+    (the manager holds ONE in-flight job; a cadence shorter than the
+    save degenerates to blocking for both modes)."""
+    import shutil
+    import tempfile
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import DataParallelTrainer, data_parallel_mesh
+    from mxnet_tpu.checkpoint import CheckpointManager, TrainingState
+
+    n = min(2, len(jax.devices()))
+    mesh = data_parallel_mesh(n, jax.devices()[:n])
+    batch, dim, hidden = 256, 1024, 512
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="ckfc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="ckfc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="ckfc3")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, dim)).astype(np.float32)
+    y = rng.randint(0, 64, (batch,)).astype(np.float32)
+    steps = 16 if QUICK else 32
+    save_every = 8
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    out = {}
+    try:
+        def _run(mode):
+            tr = DataParallelTrainer(sym, mesh, optimizer="sgd",
+                                     learning_rate=0.05, momentum=0.9,
+                                     rescale_grad=1.0 / batch,
+                                     dtype="float32")
+            params, states, aux = tr.init_state(
+                {"data": (batch, dim), "softmax_label": (batch,)})
+            inputs = tr.shard_inputs([x, y])
+            for _ in range(2):
+                params, states, aux, loss, _ = tr.step(params, states,
+                                                       aux, inputs)
+            float(loss)
+            mgr = None
+            if mode != "none":
+                mgr = CheckpointManager(os.path.join(root, mode),
+                                        async_save=(mode == "async"),
+                                        keep_last_n=2)
+            rates = []
+            gstep = 0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, states, aux, loss, _ = tr.step(params, states,
+                                                           aux, inputs)
+                    gstep += 1
+                    if mgr is not None and gstep % save_every == 0:
+                        arrays, tmeta = tr.export_training_state(
+                            params, states, aux)
+                        mgr.save(TrainingState(arrays=arrays, meta={
+                            "kind": "bench", "epoch": 0, "batch": gstep,
+                            "step": gstep, "trainer": tmeta}), step=gstep)
+                float(loss)
+                if mgr is not None:
+                    mgr.wait()
+                rates.append(steps / (time.perf_counter() - t0))
+            sps = _median(rates)
+            restore_ms = None
+            counters = {}
+            if mgr is not None:
+                t0 = time.perf_counter()
+                assert mgr.restore() is not None
+                restore_ms = (time.perf_counter() - t0) * 1e3
+                counters = mgr.counters()
+                mgr.close()
+            return sps, restore_ms, counters
+
+        base_sps, _, _ = _run("none")
+        sync_sps, sync_restore_ms, sync_c = _run("sync")
+        async_sps, _, async_c = _run("async")
+        commits = max(1, async_c.get("ckpt_commits", 1))
+        out = {
+            "baseline_steps_per_sec": round(base_sps, 2),
+            "sync_steps_per_sec": round(sync_sps, 2),
+            "async_steps_per_sec": round(async_sps, 2),
+            "sync_overhead_pct": round(
+                (base_sps / sync_sps - 1.0) * 100, 1),
+            "async_overhead_pct": round(
+                (base_sps / async_sps - 1.0) * 100, 1),
+            "ckpt_bytes_per_commit": int(
+                async_c.get("ckpt_bytes", 0) // commits),
+            "ckpt_save_ms": round(
+                async_c.get("ckpt_save_us", 0) / commits / 1e3, 1),
+            "overlap_frac": async_c.get("ckpt_overlap_frac"),
+            "restore_ms": round(sync_restore_ms, 1),
+            "devices": n,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main(argv=None):
     import argparse
 
@@ -1004,6 +1109,15 @@ def main(argv=None):
     except Exception as e:
         amp_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("amp", amp_lane)
+    # fault-tolerant checkpointing A/B: none vs sync vs async commit
+    # cadence, restore latency, bytes per commit (ISSUE 5)
+    try:
+        ckpt_lane = _gated(90, _checkpoint_lane)
+    except _BudgetExceeded:
+        ckpt_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        ckpt_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("checkpoint", ckpt_lane)
     acc_fail = None
     try:
         # the accuracy lane ASSERTS its target — never shed silently in a
@@ -1093,6 +1207,15 @@ def main(argv=None):
             "allreduce_bytes_per_step_bf16"),
         "amp_allreduce_bytes_per_step_fp32": amp_lane.get(
             "allreduce_bytes_per_step_fp32"),
+        # checkpointing (ISSUE 5): save-every-3-steps overhead vs no-ckpt
+        # baseline, sync vs saver-thread async, plus restore latency
+        "checkpoint_sync_overhead_pct": ckpt_lane.get(
+            "sync_overhead_pct", ckpt_lane.get("status")),
+        "checkpoint_async_overhead_pct": ckpt_lane.get(
+            "async_overhead_pct"),
+        "checkpoint_restore_ms": ckpt_lane.get("restore_ms"),
+        "checkpoint_bytes_per_commit": ckpt_lane.get(
+            "ckpt_bytes_per_commit"),
         "timing": "median-of-3x80-steps (20 dispatches x K=4)",
         "secondary_lane_timing": "median-of-3 windows: rn152 10 steps, "
                                  "lstm 64 steps (4xK=16), attn 10 steps",

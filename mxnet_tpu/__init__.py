@@ -60,4 +60,5 @@ from . import gluon
 from . import rnn
 from . import serving
 from . import pipeline
+from . import checkpoint
 from . import test_utils

@@ -79,6 +79,22 @@ class DynamicLossScaler:
             self.good_steps = 0
         return True
 
+    # -- checkpoint round-trip ----------------------------------------------
+
+    def get_state(self):
+        """JSON-safe snapshot of the dynamic schedule position. The
+        hyperparameters (growth/backoff/interval) are construction-time
+        config; only the live [scale, good, skipped] position needs to
+        survive a restore for bit-identical continuation."""
+        return {"scale": float(self.scale),
+                "good_steps": int(self.good_steps),
+                "skipped_steps": int(self.skipped_steps)}
+
+    def set_state(self, state):
+        self.scale = float(state["scale"])
+        self.good_steps = int(state["good_steps"])
+        self.skipped_steps = int(state["skipped_steps"])
+
     # -- trace-driven API (fused step / scan carry) -------------------------
 
     def state0(self):

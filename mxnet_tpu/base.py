@@ -14,11 +14,40 @@ import threading
 import numpy as _np
 
 __all__ = [
-    "to_numpy",
+    "to_numpy", "atomic_write",
     "MXNetError", "string_types", "numeric_types",
     "DTYPES", "np_dtype", "dtype_name",
     "NameManager", "AttrScope",
 ]
+
+
+def atomic_write(fname, payload, fsync=False):
+    """Write `payload` (bytes or str) to `fname` atomically: temp file in
+    the destination directory, then `os.replace` into place. A crash at
+    any instant leaves either the old file or the new file — never a torn
+    mix (every checkpoint/artifact writer routes through here; preemption
+    mid-save must not corrupt the previous save). `fsync=True` also syncs
+    file data before the rename (the checkpoint commit protocol needs the
+    bytes durable before the manifest references them)."""
+    import os
+    import tempfile
+    fname = os.fspath(fname)
+    d = os.path.dirname(fname) or "."
+    mode = "wb" if isinstance(payload, (bytes, bytearray, memoryview)) else "w"
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(fname) + ".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class MXNetError(RuntimeError):

@@ -24,14 +24,36 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False,
+                      manager=None):
     """Checkpoint the Module (and optionally optimizer states) every
-    `period` epochs (callback.py:27)."""
+    `period` epochs (callback.py:27).
+
+    With `manager` (a `checkpoint.CheckpointManager`, or a directory
+    string one is created for), every save routes through the
+    fault-tolerant manager instead of the legacy `prefix-NNNN.params`
+    files: atomic commit, async write, retention, and — regardless of
+    `save_optimizer_states` — the FULL training state (optimizer states
+    incl. fp32 masters, RNG, cursor), restorable with
+    `fit(checkpoint_dir=..., resume=True)` or `manager.restore()`."""
     period = int(max(1, period))
+    if manager is not None and not hasattr(manager, "save"):
+        import atexit
+        from .checkpoint import CheckpointManager
+        manager = CheckpointManager(manager)
+        # nobody else owns this manager: drain its saver thread at
+        # interpreter exit so a trailing async commit can't be torn off
+        atexit.register(manager.close)
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        if (iter_no + 1) % period != 0:
+            return
+        if manager is not None:
+            from .checkpoint import capture_module_state
+            manager.save(capture_module_state(mod, epoch=iter_no + 1),
+                         step=iter_no + 1)
+            return
+        mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
     return _callback
 
 

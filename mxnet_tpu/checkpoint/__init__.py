@@ -1,0 +1,28 @@
+"""mxnet_tpu.checkpoint — fault-tolerant training checkpoints.
+
+Beyond-reference subsystem (the reference stops at model.py's
+synchronous params-only `save_checkpoint`): atomic commits that survive
+`kill -9` at any instant, COMPLETE state capture (params + optimizer
+states incl. fp32 masters + amp loss-scaler + RNG + epoch/batch
+cursor), asynchronous saves that overlap training, retention, and
+auto-resume — docs/CHECKPOINT.md.
+
+User surface:
+
+    mod.fit(it, num_epoch=20, checkpoint_dir="ckpt", resume=True)
+        # epoch-boundary checkpoints; after preemption the same call
+        # restores the newest committed step and continues bit-identically
+
+    mgr = CheckpointManager("ckpt", keep_last_n=3, keep_best_k=1)
+    mgr.save(capture_module_state(mod, epoch=5), step=500, metric=acc)
+    state = mgr.restore()
+
+    python -m mxnet_tpu.checkpoint --selftest
+        # crash-injection proof: SIGKILL mid-save, restore, bit-identical
+"""
+from .manager import CheckpointManager
+from .state import (TrainingState, capture_module_state,
+                    restore_module_state)
+
+__all__ = ["CheckpointManager", "TrainingState", "capture_module_state",
+           "restore_module_state"]

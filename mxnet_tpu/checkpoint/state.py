@@ -1,0 +1,263 @@
+"""Full-training-state capture/restore (mxnet_tpu.checkpoint).
+
+The unit of checkpointing is a `TrainingState`: every tensor and scalar a
+training loop needs for a *bit-identical* continuation —
+
+  - arg/aux parameters (fp32 masters on every route),
+  - optimizer state: the `optimizer.Updater` states tree (momenta /
+    mean+var / fp32 master copies under multi_precision) plus the
+    pickled optimizer itself (num_update / per-index update counts —
+    Adam's bias correction needs the exact t),
+  - the fused DataParallelTrainer carries (opt-state arrays, device t,
+    PRNG key chain position, fp16 DynamicLossScaler vector),
+  - the epoch/batch cursor and the global RNG key.
+
+Capture is designed to be CHEAP on the training thread: jax arrays are
+immutable (updates rebind, never mutate), so snapshotting means cloning
+the *wrapper/structure* and holding references to the device buffers.
+The saver thread does the `device_get` + serialization later
+(manager.py), overlapping the next training steps — the DeviceFeed
+discipline, in reverse direction.
+
+On-disk encoding (see manager.py for the commit protocol):
+  arrays       -> the reference NDArray container (`arrays.nd`) so
+                  checkpoints stay inspectable with `nd.load`; entries
+                  are prefixed `param:` / `aux:` / `opt:` (fallback
+                  `arrays.pkl` for dtypes the container predates, e.g.
+                  bfloat16)
+  optimizer    -> `optimizer.bin`, the exact `Updater.get_states(
+                  dump_optimizer=True)` pickle, so `set_states` restores
+  meta         -> JSON inside the MANIFEST (cursor, RNG, amp, trainer
+                  scalars)
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+_PARAM = "param:"
+_AUX = "aux:"
+
+
+def _clone_tree(obj):
+    """Structure-copy a state tree, re-wrapping NDArrays around their
+    CURRENT immutable device buffer: later in-place updates rebind the
+    live wrapper's `_data`, never this clone's."""
+    from ..ndarray.ndarray import NDArray
+    if isinstance(obj, NDArray):
+        return NDArray(obj._data)
+    if isinstance(obj, tuple):
+        return tuple(_clone_tree(x) for x in obj)
+    if isinstance(obj, list):
+        return [_clone_tree(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _clone_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _host(v):
+    """numpy view/copy of NDArray / jax array / numpy."""
+    return _np.asarray(getattr(v, "_data", v))
+
+
+class TrainingState:
+    """One checkpointable snapshot. `arrays` maps prefixed names
+    (`param:`/`aux:`/`opt:`) to array-likes; `opt_states` +
+    `optimizer_pickle` defer the (device-transferring) optimizer-state
+    pickle to the saver thread; `meta` is the JSON-safe cursor/RNG/amp
+    record. Loaded-from-disk states carry `.step` and `.metric`."""
+
+    def __init__(self, arrays=None, opt_states=None, optimizer_pickle=None,
+                 meta=None, opt_bytes=None):
+        self.arrays = dict(arrays or {})
+        self.opt_states = opt_states
+        self.optimizer_pickle = optimizer_pickle
+        self._opt_bytes = opt_bytes
+        self.meta = dict(meta or {})
+        self.step = self.meta.get("step")
+        self.metric = None
+
+    # -- serialization (saver-thread side) ----------------------------------
+
+    def optimizer_bytes(self):
+        """The `Updater.set_states`-compatible pickle: (states, optimizer)
+        when the optimizer was captured (dump_optimizer form), else the
+        bare states tree. Pickling NDArrays transfers device->host, so
+        this runs on the saver thread."""
+        if self._opt_bytes is not None:
+            return self._opt_bytes
+        if self.opt_states is None:
+            return None
+        if self.optimizer_pickle is not None:
+            return pickle.dumps((self.opt_states,
+                                 pickle.loads(self.optimizer_pickle)))
+        return pickle.dumps(self.opt_states)
+
+    def to_files(self):
+        """[(fname, bytes)] in write order. The arrays go through the
+        reference container when every dtype has a type flag; otherwise
+        (bfloat16 et al.) a plain pickle of {name: numpy}."""
+        host = {k: _host(v) for k, v in self.arrays.items()}
+        from ..ndarray.container import container_bytes, _DTYPE_TO_FLAG
+        if all(a.dtype in _DTYPE_TO_FLAG for a in host.values()):
+            files = [("arrays.nd", container_bytes(host))]
+        else:
+            files = [("arrays.pkl", pickle.dumps(host))]
+        ob = self.optimizer_bytes()
+        if ob is not None:
+            files.append(("optimizer.bin", ob))
+        return files
+
+    @classmethod
+    def from_files(cls, blobs, manifest):
+        """Rebuild from validated {fname: bytes} + MANIFEST dict."""
+        arrays = {}
+        if "arrays.nd" in blobs:
+            from ..ndarray.container import load_container_bytes
+            items, names = load_container_bytes(blobs["arrays.nd"],
+                                                name="arrays.nd")
+            for name, item in zip(names, items):
+                if item[0] != "dense":
+                    raise ValueError(
+                        f"checkpoint: non-dense array {name!r}")
+                arrays[name] = item[1]
+        elif "arrays.pkl" in blobs:
+            arrays = pickle.loads(blobs["arrays.pkl"])
+        st = cls(arrays=arrays, meta=manifest.get("meta") or {},
+                 opt_bytes=blobs.get("optimizer.bin"))
+        st.step = int(manifest.get("step", st.meta.get("step", 0) or 0))
+        st.metric = manifest.get("metric")
+        return st
+
+    # -- restore-side views --------------------------------------------------
+
+    def _nd_dict(self, prefix):
+        from ..ndarray.ndarray import NDArray
+        return {k[len(prefix):]: NDArray(_np.asarray(_host(v)))
+                for k, v in self.arrays.items() if k.startswith(prefix)}
+
+    def arg_params_nd(self):
+        return self._nd_dict(_PARAM)
+
+    def aux_params_nd(self):
+        return self._nd_dict(_AUX)
+
+
+# ---------------------------------------------------------------------------
+# Module (per-batch fit path) capture/restore
+# ---------------------------------------------------------------------------
+
+def _updater_of(module):
+    """The live Updater holding optimizer state — the module's own, or
+    the local kvstore's when updates run on the kvstore (mirrors
+    Module.save_optimizer_states' branch)."""
+    if getattr(module, "_update_on_kvstore", False) \
+            and getattr(module, "_kvstore", None) is not None:
+        return module._kvstore._updater
+    return getattr(module, "_updater", None)
+
+
+def capture_module_state(module, epoch, batch=0, step=0):
+    """Snapshot a bound+initialized Module mid-fit. `epoch`/`batch` are
+    the CURSOR TO RESUME AT (first epoch/batch the restored run should
+    execute), not the last completed one. Cheap on the caller thread:
+    wrappers are cloned around immutable buffers, the optimizer object
+    (host-only scalars/counters) is pickled now so later mutation can't
+    race, and all device->host transfers happen at serialization time."""
+    from .. import random as _random
+    from .. import amp as _amp
+    args, auxs = module.get_params()
+    arrays = {}
+    for k, v in args.items():
+        arrays[_PARAM + k] = _clone_tree(v)
+    for k, v in auxs.items():
+        arrays[_AUX + k] = _clone_tree(v)
+    upd = _updater_of(module)
+    opt_states = _clone_tree(upd.states) if upd is not None else None
+    opt_pickle = pickle.dumps(upd.optimizer) \
+        if upd is not None and upd.optimizer is not None else None
+    meta = {
+        "kind": "module",
+        "epoch": int(epoch), "batch": int(batch), "step": int(step),
+        "rng": _random.get_state(),
+        "amp_dtype": _amp.get_dtype() if _amp.is_enabled() else None,
+    }
+    return TrainingState(arrays=arrays, opt_states=opt_states,
+                         optimizer_pickle=opt_pickle, meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# Gluon Trainer (imperative path) capture/restore
+# ---------------------------------------------------------------------------
+
+def capture_trainer_state(trainer, epoch=0, batch=0, step=0):
+    """Snapshot a gluon Trainer + its Parameters: param/aux data, the
+    updater states tree (fp32 masters under multi_precision), the pickled
+    optimizer (update counters), and the global RNG key. Same cheap-
+    capture discipline as capture_module_state."""
+    from .. import random as _random
+    from .. import amp as _amp
+    arrays = {}
+    for p in trainer._params:
+        arrays[_PARAM + p.name] = _clone_tree(p.data())
+    if not trainer._kv_initialized:
+        trainer._init_kvstore()
+    upd = trainer._kvstore._updater if trainer._update_on_kvstore \
+        else trainer._updaters[0]
+    opt_states = _clone_tree(upd.states)
+    opt_pickle = pickle.dumps(upd.optimizer) \
+        if upd.optimizer is not None else None
+    meta = {
+        "kind": "gluon_trainer",
+        "epoch": int(epoch), "batch": int(batch), "step": int(step),
+        "rng": _random.get_state(),
+        "amp_dtype": _amp.get_dtype() if _amp.is_enabled() else None,
+    }
+    return TrainingState(arrays=arrays, opt_states=opt_states,
+                         optimizer_pickle=opt_pickle, meta=meta)
+
+
+def restore_trainer_state(trainer, state):
+    """Re-arm a gluon Trainer from a snapshot: parameter data (set_data
+    on every Parameter present in the snapshot), optimizer states/
+    counters across all updaters, and the global RNG key."""
+    from .. import random as _random
+    args = state.arg_params_nd()
+    for p in trainer._params:
+        if p.name in args:
+            p.set_data(args[p.name])
+    ob = state.optimizer_bytes()
+    if ob is not None:
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if trainer._update_on_kvstore:
+            trainer._kvstore._updater.set_states(ob)
+            trainer._optimizer = trainer._kvstore._updater.optimizer
+        else:
+            for updater in trainer._updaters:
+                updater.set_states(ob)
+                updater.optimizer = trainer._updaters[0].optimizer
+            trainer._optimizer = trainer._updaters[0].optimizer
+        trainer._optimizer.param_dict = {
+            i: param for i, param in enumerate(trainer._params)}
+    if state.meta.get("rng") is not None:
+        _random.set_state(state.meta["rng"])
+
+
+def restore_module_state(module, state):
+    """Re-arm a bound+initialized Module from a snapshot: optimizer
+    states (incl. fp32 masters and update counters) and the global RNG
+    key. Params/aux are restored separately through init_params (the
+    snapshot's arg_params_nd()/aux_params_nd() feed its cache)."""
+    from .. import random as _random
+    upd = _updater_of(module)
+    ob = state.optimizer_bytes()
+    if upd is not None and ob is not None:
+        upd.set_states(ob)
+        if upd.optimizer is not None and hasattr(module, "_optimizer"):
+            # set_states(dump form) replaces the updater's optimizer; keep
+            # the module's reference pointing at the live instance
+            module._optimizer = upd.optimizer
+    if state.meta.get("rng") is not None:
+        _random.set_state(state.meta["rng"])

@@ -75,6 +75,15 @@ _DOCUMENTED = {
     # MXNET_AMP=0 leaves every program bit-identical to fp32.
     "MXNET_AMP": 0,
     "MXNET_AMP_DTYPE": "bfloat16",
+    # fault-tolerant checkpointing (mxnet_tpu.checkpoint,
+    # docs/CHECKPOINT.md): MXNET_CHECKPOINT_ASYNC=0 makes every
+    # CheckpointManager.save commit synchronously on the training
+    # thread; MXNET_CHECKPOINT_KEEP is the keep-last-N retention
+    # default (<=0 keeps everything); MXNET_CHECKPOINT_BEST_K
+    # additionally retains the best k steps by the save metric
+    "MXNET_CHECKPOINT_ASYNC": 1,
+    "MXNET_CHECKPOINT_KEEP": 3,
+    "MXNET_CHECKPOINT_BEST_K": 0,
 }
 
 

@@ -29,7 +29,8 @@ __all__ = ["Trainer", "fused_fit"]
 
 def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
               optimizer_params=None, steps_per_dispatch=8, contexts=None,
-              dtype=None, epoch_callback=None):
+              dtype=None, epoch_callback=None, checkpoint_dir=None,
+              checkpoint_period=None, resume=False):
     """K-steps-per-dispatch training driver for gluon nets
     (steps_per_dispatch, beyond-reference; Module.fit's equivalent knob).
 
@@ -52,6 +53,14 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
     optimizer must have a fused update op (parallel.dp._OPT_OPS), and the
     training metric is the loss itself — per-batch prediction metrics
     need Module.fit(steps_per_dispatch=K)'s outputs_mode="all" path.
+
+    Fault tolerance (mxnet_tpu.checkpoint, docs/CHECKPOINT.md):
+    `checkpoint_dir` commits an atomic full-state checkpoint (params,
+    optimizer states, device t/rng/loss-scaler carries, cursor) at every
+    epoch boundary — plus every `checkpoint_period` fused steps — and
+    `resume=True` restores the newest committed step for a bit-identical
+    continuation. SIGTERM takes one final checkpoint at the next block
+    boundary and exits 143.
     """
     import itertools
     import numpy as np
@@ -109,6 +118,37 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
         aux_params={n: pmap[n].data() for n in trainer.aux_names
                     if n in pmap})
 
+    begin_epoch, gstep, ckpt_skip = 0, 0, 0
+    ckpt_mgr = None
+    if checkpoint_dir is not None:
+        from ..checkpoint import CheckpointManager
+        ckpt_mgr = CheckpointManager(checkpoint_dir)
+        if resume:
+            ckpt_state = ckpt_mgr.restore()
+            if ckpt_state is not None:
+                from .. import random as _random
+                if ckpt_state.meta.get("trainer") is not None:
+                    params, states, aux = trainer.import_training_state(
+                        ckpt_state.arrays, ckpt_state.meta["trainer"])
+                if ckpt_state.meta.get("rng") is not None:
+                    _random.set_state(ckpt_state.meta["rng"])
+                begin_epoch = int(ckpt_state.meta.get("epoch", 0))
+                gstep = int(ckpt_state.meta.get("step", 0))
+                ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+        ckpt_mgr.install_sigterm_hook()
+
+    def _ckpt_capture(next_epoch, next_batch):
+        # synchronous device snapshot between dispatches; serialization
+        # overlaps the following steps on the manager's saver thread
+        from ..checkpoint.state import TrainingState
+        from .. import random as _random
+        arrays, tmeta = trainer.export_training_state(params, states, aux)
+        return TrainingState(arrays=arrays, meta={
+            "kind": "gluon_fused", "epoch": int(next_epoch),
+            "batch": int(next_batch), "step": int(gstep),
+            "trainer": tmeta, "rng": _random.get_state(),
+            "amp_dtype": dtype if dtype != "float32" else None})
+
     from ..base import to_numpy as _np_of
 
     def _writeback():
@@ -141,32 +181,62 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
 
     k = int(steps_per_dispatch)
     epoch_losses = []
-    for epoch in range(num_epoch):
-        total, count = 0.0, 0
-        stream = itertools.chain([first], it) if epoch == 0 \
-            else iter(train_data)
-        feed = feed_or_inline(_blocks(stream), _stage_block,
-                              name="gluon_fused_fit")
-        try:
-            for inputs, n_blk in feed:
-                params, states, aux, losses, _ = trainer.step_k(
-                    params, states, aux, inputs)
-                total += float(np.sum(np.asarray(losses)))
-                count += n_blk * batch
-        finally:
-            close_feed(feed)
-        if count == 0:
-            # a single-pass generator exhausts after epoch 0 — failing
-            # loudly beats recording 0.0-loss "epochs" that trained nothing
-            raise MXNetError(
-                f"fused_fit: epoch {epoch} yielded no batches (is "
-                "train_data a single-pass generator? pass a re-iterable "
-                "like a DataLoader or list)")
-        mean_loss = total / max(count, 1)
-        epoch_losses.append(mean_loss)
-        _writeback()
-        if epoch_callback is not None:
-            epoch_callback(epoch, net, mean_loss)
+    try:
+        for epoch in range(begin_epoch, num_epoch):
+            total, count = 0.0, 0
+            stream = itertools.chain([first], it) if epoch == 0 \
+                else iter(train_data)
+            if ckpt_skip:
+                for _ in itertools.islice(stream, ckpt_skip):
+                    pass
+            nbatch = ckpt_skip
+            ckpt_skip = 0
+            last_ckpt = gstep
+            feed = feed_or_inline(_blocks(stream), _stage_block,
+                                  name="gluon_fused_fit")
+            try:
+                for inputs, n_blk in feed:
+                    params, states, aux, losses, _ = trainer.step_k(
+                        params, states, aux, inputs)
+                    total += float(np.sum(np.asarray(losses)))
+                    count += n_blk * batch
+                    nbatch += n_blk
+                    gstep += n_blk
+                    if ckpt_mgr is not None:
+                        if checkpoint_period and \
+                                gstep - last_ckpt >= int(checkpoint_period):
+                            ckpt_mgr.save(_ckpt_capture(epoch, nbatch),
+                                          step=gstep)
+                            last_ckpt = gstep
+                        if ckpt_mgr.preempted:
+                            ckpt_mgr.save(_ckpt_capture(epoch, nbatch),
+                                          step=gstep, blocking=True)
+                            raise SystemExit(143)
+            finally:
+                close_feed(feed)
+            if count == 0:
+                # a single-pass generator exhausts after epoch 0 — failing
+                # loudly beats recording 0.0-loss "epochs" that trained
+                # nothing
+                raise MXNetError(
+                    f"fused_fit: epoch {epoch} yielded no batches (is "
+                    "train_data a single-pass generator? pass a "
+                    "re-iterable like a DataLoader or list)")
+            mean_loss = total / max(count, 1)
+            epoch_losses.append(mean_loss)
+            _writeback()
+            if epoch_callback is not None:
+                epoch_callback(epoch, net, mean_loss)
+            if ckpt_mgr is not None:
+                ckpt_mgr.save(_ckpt_capture(epoch + 1, 0), step=gstep,
+                              metric=mean_loss)
+                if ckpt_mgr.preempted:
+                    ckpt_mgr.wait()
+                    raise SystemExit(143)
+    finally:
+        if ckpt_mgr is not None:
+            ckpt_mgr.remove_sigterm_hook()
+            ckpt_mgr.close()
     return epoch_losses
 
 
@@ -385,8 +455,8 @@ class Trainer:
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+            from ..base import atomic_write
+            atomic_write(fname, self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
@@ -403,3 +473,32 @@ class Trainer:
             self._optimizer = self._updaters[0].optimizer
         param_dict = {i: param for i, param in enumerate(self._params)}
         self._optimizer.param_dict = param_dict
+
+    # -- fault-tolerant checkpoints (mxnet_tpu.checkpoint) -------------------
+
+    def save_checkpoint(self, directory, step, metric=None):
+        """Commit a FULL-state checkpoint (params + optimizer states incl.
+        fp32 masters + RNG) through the atomic CheckpointManager.
+        `directory` is a checkpoint root or an existing manager; returns
+        the manager (reuse it across steps to keep retention state)."""
+        from ..checkpoint import CheckpointManager
+        from ..checkpoint.state import capture_trainer_state
+        mgr = directory if hasattr(directory, "save") \
+            else CheckpointManager(directory)
+        mgr.save(capture_trainer_state(self, step=step), step=step,
+                 metric=metric, blocking=True)
+        return mgr
+
+    def restore_checkpoint(self, directory, step=None):
+        """Auto-restore the newest committed checkpoint (or exactly
+        `step`) into this Trainer's Parameters and optimizer. Returns the
+        restored step number, or None when nothing restorable exists."""
+        from ..checkpoint import CheckpointManager
+        from ..checkpoint.state import restore_trainer_state
+        mgr = directory if hasattr(directory, "restore") \
+            else CheckpointManager(directory)
+        state = mgr.restore(step)
+        if state is None:
+            return None
+        restore_trainer_state(self, state)
+        return state.step

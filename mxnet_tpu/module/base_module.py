@@ -175,8 +175,19 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1):
+            monitor=None, sparse_row_id_fn=None, steps_per_dispatch=1,
+            checkpoint_dir=None, checkpoint_period=None, resume=False):
         """The full training loop (base_module.py:395).
+
+        `checkpoint_dir` (beyond-reference, docs/CHECKPOINT.md) arms
+        fault-tolerant checkpointing: a CheckpointManager commits the
+        COMPLETE training state (params, optimizer states incl. fp32
+        masters, amp scaler, RNG, epoch/batch cursor) atomically at
+        every epoch boundary (plus every `checkpoint_period` batches
+        when set), asynchronously overlapping the write with training.
+        `resume=True` restores the newest committed step and continues
+        bit-identically to the uninterrupted run; SIGTERM triggers one
+        final checkpoint at the next batch boundary, then exit 143.
 
         `steps_per_dispatch=K` (K>1, beyond-reference) runs K consecutive
         training steps inside ONE compiled dispatch (a jitted lax.scan over
@@ -216,9 +227,31 @@ class BaseModule:
                 begin_epoch=begin_epoch, num_epoch=num_epoch,
                 validation_metric=validation_metric, monitor=monitor,
                 sparse_row_id_fn=sparse_row_id_fn,
-                steps_per_dispatch=int(steps_per_dispatch))
+                steps_per_dispatch=int(steps_per_dispatch),
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_period=checkpoint_period, resume=resume)
             if handled:
                 return
+
+        ckpt_mgr = None
+        ckpt_state = None
+        if checkpoint_dir is not None:
+            from ..checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(checkpoint_dir, logger=self.logger)
+            if resume:
+                ckpt_state = ckpt_mgr.restore()
+                if ckpt_state is not None:
+                    # the snapshot wholesale replaces any user-passed
+                    # initial params: resuming means continuing THAT run
+                    arg_params = ckpt_state.arg_params_nd()
+                    aux_params = ckpt_state.aux_params_nd()
+                    force_init = True
+                    begin_epoch = int(ckpt_state.meta.get("epoch",
+                                                          begin_epoch))
+                    self.logger.info(
+                        "checkpoint: resuming from committed step %s "
+                        "(epoch %d, batch %d)", ckpt_state.step,
+                        begin_epoch, int(ckpt_state.meta.get("batch", 0)))
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -231,6 +264,16 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        gstep = 0
+        ckpt_skip = 0
+        if ckpt_state is not None:
+            from ..checkpoint.state import restore_module_state
+            restore_module_state(self, ckpt_state)
+            gstep = int(ckpt_state.meta.get("step", 0))
+            ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+        if ckpt_mgr is not None:
+            ckpt_mgr.install_sigterm_hook()
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -241,72 +284,117 @@ class BaseModule:
 
         from ..pipeline import feed_or_inline, close_feed, module_stage
 
-        for epoch in range(begin_epoch, num_epoch):
-            epoch_start = time.time()
-            eval_metric.reset()
-            # iterator contract: a DataBatch is only guaranteed valid until
-            # the next next() call (legacy buffer-reusing iterators) — the
-            # sync path honors it by fetching batch N+1 only AFTER batch
-            # N's forward/update; the device feed honors it by COPYING
-            # each batch onto device at prefetch time (pipeline.py), and
-            # stages batch N+1 while step N executes
-            data_iter = feed_or_inline(iter(train_data), module_stage(self),
-                                       name="module_fit")
-            data_batch = next(data_iter, None)
-            nbatch = 0
-            try:
-                while data_batch is not None:
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                    upcoming = next(data_iter, None)
-                    if upcoming is not None:
-                        # hand the next batch to the prefetch hook while
-                        # this step's arrays are still settling (async
-                        # dispatch)
-                        self.prepare(upcoming,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    self.update_metric(eval_metric, data_batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    # contract: callbacks fire AFTER the metric update and
-                    # see the loop state through `locals` (Speedometer &
-                    # friends)
-                    if batch_callbacks:
-                        cb_param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                        for callback in batch_callbacks:
-                            callback(cb_param)
-                    data_batch = upcoming
-                    nbatch += 1
-            finally:
-                close_feed(data_iter)
+        def _ckpt_save(next_epoch, next_batch, metric_val=None,
+                       blocking=None):
+            from ..checkpoint.state import capture_module_state
+            ckpt_mgr.save(
+                capture_module_state(self, epoch=next_epoch,
+                                     batch=next_batch, step=gstep),
+                step=gstep, metric=metric_val, blocking=blocking)
 
-            # log-format contract: "Epoch[N] Train-<metric>=<val>" lines
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - epoch_start)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                epoch_start = time.time()
+                eval_metric.reset()
+                # iterator contract: a DataBatch is only guaranteed valid
+                # until the next next() call (legacy buffer-reusing
+                # iterators) — the sync path honors it by fetching batch
+                # N+1 only AFTER batch N's forward/update; the device feed
+                # honors it by COPYING each batch onto device at prefetch
+                # time (pipeline.py), and stages batch N+1 while step N
+                # executes
+                src = iter(train_data)
+                if ckpt_skip:
+                    # mid-epoch resume: replay the iterator up to the
+                    # saved cursor so batch order matches the
+                    # uninterrupted run
+                    self.logger.info(
+                        "checkpoint: fast-forwarding %d batches to the "
+                        "saved cursor", ckpt_skip)
+                    for _ in itertools.islice(src, ckpt_skip):
+                        pass
+                data_iter = feed_or_inline(src, module_stage(self),
+                                           name="module_fit")
+                data_batch = next(data_iter, None)
+                nbatch = ckpt_skip
+                ckpt_skip = 0
+                try:
+                    while data_batch is not None:
+                        if monitor is not None:
+                            monitor.tic()
+                        self.forward_backward(data_batch)
+                        self.update()
+                        upcoming = next(data_iter, None)
+                        if upcoming is not None:
+                            # hand the next batch to the prefetch hook
+                            # while this step's arrays are still settling
+                            # (async dispatch)
+                            self.prepare(upcoming,
+                                         sparse_row_id_fn=sparse_row_id_fn)
+                        self.update_metric(eval_metric, data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        # contract: callbacks fire AFTER the metric update
+                        # and see the loop state through `locals`
+                        # (Speedometer & friends)
+                        if batch_callbacks:
+                            cb_param = BatchEndParam(epoch=epoch,
+                                                     nbatch=nbatch,
+                                                     eval_metric=eval_metric,
+                                                     locals=locals())
+                            for callback in batch_callbacks:
+                                callback(cb_param)
+                        data_batch = upcoming
+                        nbatch += 1
+                        gstep += 1
+                        if ckpt_mgr is not None:
+                            if checkpoint_period and \
+                                    nbatch % int(checkpoint_period) == 0:
+                                _ckpt_save(epoch, nbatch)
+                            if ckpt_mgr.preempted:
+                                _ckpt_save(epoch, nbatch, blocking=True)
+                                raise SystemExit(143)
+                finally:
+                    close_feed(data_iter)
 
-            # round-trip params through get/set: commits device values to
-            # the host-visible dicts checkpoints and callbacks read
-            snapshot_args, snapshot_aux = self.get_params()
-            self.set_params(snapshot_args, snapshot_aux)
-            for callback in epoch_callbacks:
-                callback(epoch, self.symbol, snapshot_args, snapshot_aux)
+                # log-format contract: "Epoch[N] Train-<metric>=<val>"
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - epoch_start)
 
-            if eval_data is not None:
-                for name, val in self.score(
-                        eval_data, validation_metric,
-                        score_end_callback=eval_end_callback,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch):
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                # round-trip params through get/set: commits device values
+                # to the host-visible dicts checkpoints and callbacks read
+                snapshot_args, snapshot_aux = self.get_params()
+                self.set_params(snapshot_args, snapshot_aux)
+                for callback in epoch_callbacks:
+                    callback(epoch, self.symbol, snapshot_args,
+                             snapshot_aux)
 
-            train_data.reset()
+                if ckpt_mgr is not None:
+                    vals = eval_metric.get_name_value()
+                    _ckpt_save(epoch + 1, 0,
+                               metric_val=float(vals[0][1]) if vals
+                               else None)
+                    if ckpt_mgr.preempted:
+                        ckpt_mgr.wait()
+                        raise SystemExit(143)
+
+                if eval_data is not None:
+                    for name, val in self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+
+                train_data.reset()
+        finally:
+            if ckpt_mgr is not None:
+                ckpt_mgr.remove_sigterm_hook()
+                ckpt_mgr.close()
 
     def _fit_fused(self, train_data, **kwargs):
         """steps_per_dispatch>1 hook. Subclasses that can fuse K steps into

@@ -101,6 +101,14 @@ class Module(BaseModule):
         self.save_params(param_name)
         logging.info('Saved checkpoint to "%s"', param_name)
         if save_optimizer_states:
+            if not self.optimizer_initialized:
+                # fused fit (steps_per_dispatch>1) keeps the optimizer
+                # inside the jitted trainer — use fit(checkpoint_dir=...)
+                # for full-state snapshots there
+                logging.warning(
+                    "save_checkpoint: optimizer not initialized (fused "
+                    "fit?); skipping optimizer states for %s", prefix)
+                return
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)
             logging.info('Saved optimizer state to "%s"', state_name)
@@ -326,7 +334,9 @@ class Module(BaseModule):
                    eval_batch_end_callback, initializer, arg_params,
                    aux_params, allow_missing, force_rebind, force_init,
                    begin_epoch, num_epoch, validation_metric, monitor,
-                   sparse_row_id_fn, steps_per_dispatch):
+                   sparse_row_id_fn, steps_per_dispatch,
+                   checkpoint_dir=None, checkpoint_period=None,
+                   resume=False):
         """K-steps-per-dispatch training loop (see BaseModule.fit docs).
 
         The per-batch executor+updater machinery is replaced for the epoch
@@ -392,6 +402,25 @@ class Module(BaseModule):
             return False
 
         k = steps_per_dispatch
+
+        ckpt_mgr = None
+        ckpt_state = None
+        if checkpoint_dir is not None:
+            from ..checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(checkpoint_dir, logger=self.logger)
+            if resume:
+                ckpt_state = ckpt_mgr.restore()
+                if ckpt_state is not None:
+                    arg_params = ckpt_state.arg_params_nd()
+                    aux_params = ckpt_state.aux_params_nd()
+                    force_init = True
+                    begin_epoch = int(ckpt_state.meta.get("epoch",
+                                                          begin_epoch))
+                    self.logger.info(
+                        "checkpoint: resuming fused fit from committed "
+                        "step %s (epoch %d, batch %d)", ckpt_state.step,
+                        begin_epoch, int(ckpt_state.meta.get("batch", 0)))
+
         # normal bind + init so the parameter draw is identical to K=1
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -433,6 +462,28 @@ class Module(BaseModule):
             shape_kwargs, arg_params=self._arg_params,
             aux_params=self._aux_params)
 
+        gstep = 0
+        ckpt_skip = 0
+        if ckpt_state is not None:
+            if ckpt_state.meta.get("kind") == "module_fused" and \
+                    ckpt_state.meta.get("trainer") is not None:
+                # full fused-loop state: opt-state arrays + device t/rng/
+                # loss-scaler carries — the continuation is bit-identical
+                params, states, aux = trainer.import_training_state(
+                    ckpt_state.arrays, ckpt_state.meta["trainer"])
+            else:
+                self.logger.warning(
+                    "checkpoint: snapshot kind=%r has no fused-trainer "
+                    "state; params restored, optimizer state starts "
+                    "fresh", ckpt_state.meta.get("kind"))
+            from .. import random as _random
+            if ckpt_state.meta.get("rng") is not None:
+                _random.set_state(ckpt_state.meta["rng"])
+            gstep = int(ckpt_state.meta.get("step", 0))
+            ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+        if ckpt_mgr is not None:
+            ckpt_mgr.install_sigterm_hook()
+
         from ..base import to_numpy as _np_of
         from ..pipeline import feed_or_inline, close_feed
         data_idx = {n: i for i, n in enumerate(self._data_names)}
@@ -465,64 +516,120 @@ class Module(BaseModule):
                 for name, i in label_idx.items()}
             return inputs, labels, len(block)
 
-        for epoch in range(begin_epoch, num_epoch):
-            epoch_start = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            feed = feed_or_inline(_blocks(iter(train_data)), _stage_block,
-                                  name="module_fit_fused")
-            try:
-                for inputs, label_np, n_blk in feed:
-                    params, states, aux, losses, outputs = trainer.step_k(
-                        params, states, aux, inputs, outputs_mode="all")
-                    # metric over ALL K batches at once: flatten the scan
-                    # axis into the batch axis (same samples K=1 would feed
-                    # one by one, one update call instead of K)
-                    pred_dict = {
-                        name: NDArray(o.reshape((-1,) + o.shape[2:]))
-                        for name, o in zip(self._output_names, outputs)}
-                    label_dict = {name: NDArray(v)
-                                  for name, v in label_np.items()}
-                    eval_metric.update_dict(label_dict, pred_dict)
-                    nbatch += n_blk
-                    if batch_callbacks:
-                        cb_param = BatchEndParam(epoch=epoch,
-                                                 nbatch=nbatch - 1,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                        for callback in batch_callbacks:
-                            callback(cb_param)
-            finally:
-                close_feed(feed)
+        def _ckpt_capture(next_epoch, next_batch):
+            # synchronous snapshot of the (donated) device tuples — must
+            # happen between dispatches; the atomic write itself still
+            # overlaps the following steps on the saver thread
+            from ..checkpoint.state import TrainingState
+            from .. import random as _random
+            arrays, tmeta = trainer.export_training_state(params, states,
+                                                          aux)
+            return TrainingState(arrays=arrays, meta={
+                "kind": "module_fused", "epoch": int(next_epoch),
+                "batch": int(next_batch), "step": int(gstep),
+                "trainer": tmeta, "rng": _random.get_state(),
+                "amp_dtype": fit_dtype if fit_dtype != "float32"
+                else None})
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - epoch_start)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                epoch_start = time.time()
+                eval_metric.reset()
+                src = iter(train_data)
+                if ckpt_skip:
+                    self.logger.info(
+                        "checkpoint: fast-forwarding %d batches to the "
+                        "saved cursor", ckpt_skip)
+                    for _ in itertools.islice(src, ckpt_skip):
+                        pass
+                nbatch = ckpt_skip
+                ckpt_skip = 0
+                last_ckpt = gstep
+                feed = feed_or_inline(_blocks(src), _stage_block,
+                                      name="module_fit_fused")
+                try:
+                    for inputs, label_np, n_blk in feed:
+                        params, states, aux, losses, outputs = \
+                            trainer.step_k(params, states, aux, inputs,
+                                           outputs_mode="all")
+                        # metric over ALL K batches at once: flatten the
+                        # scan axis into the batch axis (same samples K=1
+                        # would feed one by one, one update call instead
+                        # of K)
+                        pred_dict = {
+                            name: NDArray(o.reshape((-1,) + o.shape[2:]))
+                            for name, o in zip(self._output_names,
+                                               outputs)}
+                        label_dict = {name: NDArray(v)
+                                      for name, v in label_np.items()}
+                        eval_metric.update_dict(label_dict, pred_dict)
+                        nbatch += n_blk
+                        gstep += n_blk
+                        if batch_callbacks:
+                            cb_param = BatchEndParam(epoch=epoch,
+                                                     nbatch=nbatch - 1,
+                                                     eval_metric=eval_metric,
+                                                     locals=locals())
+                            for callback in batch_callbacks:
+                                callback(cb_param)
+                        if ckpt_mgr is not None:
+                            if checkpoint_period and \
+                                    gstep - last_ckpt >= \
+                                    int(checkpoint_period):
+                                ckpt_mgr.save(_ckpt_capture(epoch, nbatch),
+                                              step=gstep)
+                                last_ckpt = gstep
+                            if ckpt_mgr.preempted:
+                                ckpt_mgr.save(_ckpt_capture(epoch, nbatch),
+                                              step=gstep, blocking=True)
+                                raise SystemExit(143)
+                finally:
+                    close_feed(feed)
 
-            # write the device-carried state back so checkpoints/callbacks/
-            # validation see the trained params exactly as K=1 would.
-            # COPIES (np.asarray), not the live buffers: step_k donates its
-            # params, so aliasing them into the executor would leave it
-            # holding deleted arrays after the next epoch's first dispatch
-            self.set_params(
-                {n: NDArray(np.asarray(p)) for n, p in
-                 zip(trainer.param_names, params)},
-                {n: NDArray(np.asarray(a))
-                 for n, a in zip(trainer.aux_names, aux)})
-            snapshot_args, snapshot_aux = self.get_params()
-            for callback in epoch_callbacks:
-                callback(epoch, self.symbol, snapshot_args, snapshot_aux)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - epoch_start)
 
-            if eval_data is not None:
-                for name, val in self.score(
-                        eval_data, validation_metric,
-                        score_end_callback=eval_end_callback,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch):
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
+                # write the device-carried state back so checkpoints/
+                # callbacks/validation see the trained params exactly as
+                # K=1 would. COPIES (np.asarray), not the live buffers:
+                # step_k donates its params, so aliasing them into the
+                # executor would leave it holding deleted arrays after the
+                # next epoch's first dispatch
+                self.set_params(
+                    {n: NDArray(np.asarray(p)) for n, p in
+                     zip(trainer.param_names, params)},
+                    {n: NDArray(np.asarray(a))
+                     for n, a in zip(trainer.aux_names, aux)})
+                snapshot_args, snapshot_aux = self.get_params()
+                for callback in epoch_callbacks:
+                    callback(epoch, self.symbol, snapshot_args,
+                             snapshot_aux)
+
+                if ckpt_mgr is not None:
+                    vals = eval_metric.get_name_value()
+                    ckpt_mgr.save(_ckpt_capture(epoch + 1, 0), step=gstep,
+                                  metric=float(vals[0][1]) if vals
+                                  else None)
+                    if ckpt_mgr.preempted:
+                        ckpt_mgr.wait()
+                        raise SystemExit(143)
+
+                if eval_data is not None:
+                    for name, val in self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            if ckpt_mgr is not None:
+                ckpt_mgr.remove_sigterm_hook()
+                ckpt_mgr.close()
         return True
 
     # -- optimizer -----------------------------------------------------------
@@ -686,8 +793,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            from ..base import atomic_write
+            atomic_write(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

@@ -32,7 +32,7 @@ import struct
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, atomic_write
 
 LIST_MAGIC = 0x112
 V2_MAGIC = 0xF993FAC9
@@ -191,9 +191,9 @@ def _load_one(r):
     return ("dense", r.array(_dtype_of(r.i32()), shape))
 
 
-def save_container(fname, data):
+def container_bytes(data):
     """Serialize {name: NDArray} / [NDArray] / NDArray to the reference
-    container (NDArray::Save list form, ndarray.cc:1787)."""
+    container wire bytes (NDArray::Save list form, ndarray.cc:1787)."""
     if hasattr(data, "keys"):
         names = list(data.keys())
         arrays = [data[k] for k in names]
@@ -210,14 +210,36 @@ def save_container(fname, data):
         b = n.encode("utf-8")
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    return b"".join(out)
+
+
+def save_container(fname, data, fsync=False):
+    """Write a reference container atomically (temp file + os.replace):
+    preemption mid-save leaves the previous `{prefix}-{epoch:04d}.params`
+    intact instead of a torn, unloadable file."""
+    atomic_write(fname, container_bytes(data), fsync=fsync)
 
 
 def is_container(head):
     """Sniff the first 8 bytes for the list magic."""
     return len(head) >= 8 and \
         struct.unpack("<Q", head[:8])[0] == LIST_MAGIC
+
+
+def load_container_bytes(buf, name="<bytes>"):
+    """Parse container wire bytes -> (recipes, names) (see _load_one)."""
+    r = _Reader(buf)
+    if r.u64() != LIST_MAGIC:
+        raise MXNetError(f"{name}: not an NDArray container")
+    r.u64()                                            # reserved
+    items = [_load_one(r) for _ in range(r.u64())]
+    names = []
+    for _ in range(r.u64()):
+        names.append(r.read(r.u64()).decode("utf-8"))
+    if names and len(names) != len(items):
+        raise MXNetError(f"{name}: {len(items)} arrays but {len(names)} "
+                         "names")
+    return items, names
 
 
 def load_container(fname):

@@ -485,6 +485,59 @@ class DataParallelTrainer:
         return {"amp_scale": self.loss_scale,
                 "amp_skipped_steps": self.skipped_steps}
 
+    # -- checkpoint round-trip ----------------------------------------------
+
+    def export_training_state(self, params, states, aux):
+        """Host snapshot of the full fused-loop training state: the
+        (donated, device-carried) params/opt-states/aux tuples as numpy,
+        plus the device-carried step counter, PRNG key chain position and
+        fp16 loss-scaler vector. Everything mxnet_tpu.checkpoint needs for
+        a bit-identical step_k continuation after restore. Must be called
+        between dispatches (the tuples are invalidated by the next step's
+        donation — copy now, serialize later)."""
+        from .. import random as _random
+        arrays = {}
+        for n, p in zip(self._param_names, params):
+            arrays[f"param:{n}"] = _np.asarray(p)
+        for n, st in zip(self._param_names, states):
+            for i, s in enumerate(st):
+                arrays[f"opt:{n}:{i}"] = _np.asarray(s)
+        for n, a in zip(self._aux_names, aux):
+            arrays[f"aux:{n}"] = _np.asarray(a)
+        meta = {
+            "t": float(self._t if self._t_dev is None
+                       else _np.asarray(self._t_dev)),
+            "rng": None if self._rng_dev is None
+            else _random.key_data(self._rng_dev).ravel().tolist(),
+            "loss_scaler": None if not (self._has_ls
+                                        and self._ls_dev is not None)
+            else [float(x) for x in _np.asarray(self._ls_dev)],
+        }
+        return arrays, meta
+
+    def import_training_state(self, arrays, meta):
+        """Inverse of export_training_state: re-commit a snapshot to the
+        mesh. Returns (params, states, aux) replicated tuples ready for
+        step/step_k; the internal t/rng/loss-scaler carries are restored
+        so the continuation is bit-identical to the uninterrupted run."""
+        from .. import random as _random
+        put = lambda v: jax.device_put(_np.asarray(v), self._repl)
+        params = tuple(put(arrays[f"param:{n}"]) for n in self._param_names)
+        states = tuple(
+            tuple(put(arrays[f"opt:{n}:{i}"])
+                  for i in range(self._n_states))
+            for n in self._param_names)
+        aux = tuple(put(arrays[f"aux:{n}"]) for n in self._aux_names)
+        self._t = float(meta.get("t", 0.0))
+        self._t_dev = put(_np.float32(self._t))
+        if meta.get("rng") is not None:
+            self._rng_dev = jax.device_put(_random.wrap_key(meta["rng"]),
+                                           self._repl)
+        ls = meta.get("loss_scaler")
+        if ls is not None and self._has_ls:
+            self._ls_dev = put(_np.asarray(ls, _np.float32))
+        return params, states, aux
+
     def step(self, params, states, aux, inputs, rng=None):
         self._ensure_dev_state(rng)
         if self._has_ls:

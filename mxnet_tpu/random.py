@@ -34,3 +34,42 @@ def next_key():
     k, sub = jax.random.split(k)
     _state.key = k
     return sub
+
+
+def _is_typed_key(k):
+    try:
+        return jax.numpy.issubdtype(k.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def key_data(k):
+    """Raw uint32 buffer of a PRNG key — legacy uint32 arrays pass
+    through, typed keys are unwrapped (checkpoint serialization)."""
+    import numpy as np
+    if _is_typed_key(k):
+        return np.asarray(jax.random.key_data(k))
+    return np.asarray(k)
+
+
+def get_state():
+    """Serializable snapshot of the global PRNG key (checkpointing:
+    mxnet_tpu.checkpoint captures it so a resumed run continues the same
+    key-split chain). Returns a plain list of ints (JSON-safe)."""
+    return key_data(_key()).ravel().tolist()
+
+
+def wrap_key(state):
+    """Inverse of key_data: rebuild a usable key (matching this jax
+    version's key style) from the raw uint32 snapshot."""
+    import numpy as np
+    k = _key()                      # layout template for this jax version
+    raw = np.asarray(state, dtype=np.uint32).reshape(key_data(k).shape)
+    if _is_typed_key(k):
+        return jax.random.wrap_key_data(jax.numpy.asarray(raw))
+    return jax.numpy.asarray(raw)
+
+
+def set_state(state):
+    """Restore a snapshot from get_state()."""
+    _state.key = wrap_key(state)

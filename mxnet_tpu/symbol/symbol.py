@@ -392,8 +392,8 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..base import atomic_write
+        atomic_write(fname, self.tojson())
 
     # -- binding ------------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,

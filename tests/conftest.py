@@ -70,6 +70,8 @@ _QUICK = {
     "test_pipeline.py::test_module_fit_bit_identical_with_feed",
     "test_amp.py::test_amp_bf16_mlp_converges_with_f32_masters",
     "test_amp.py::test_fp16_scaler_skips_step_and_halves_scale",
+    "test_checkpoint.py::test_atomic_commit_roundtrip",
+    "test_checkpoint.py::test_module_fit_resume_bit_identical",
 }
 
 

@@ -35,7 +35,10 @@ case "$stage" in
       python -m mxnet_tpu.pipeline --selftest
     echo "== amp smoke (autocast no-op / bf16 convergence / fp16 scaler)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-      python -m mxnet_tpu.amp --selftest ;;
+      python -m mxnet_tpu.amp --selftest
+    echo "== checkpoint smoke (crash injection: SIGKILL mid-commit, resume)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.checkpoint --selftest ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)
