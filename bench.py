@@ -66,6 +66,21 @@ def _emit(lane, payload):
     print(json.dumps(rec), flush=True)
 
 
+def _heartbeat(name, event, **extra):
+    """Flushed per-lane liveness line ({"lane": name, "event":
+    "lane_start"/"lane_end", ...}): a future rc=124 names its last-live
+    lane on stdout, and the telemetry watchdog's last-beat label matches
+    (the deadline stack dump armed in main() covers the rest)."""
+    _emit(name, {"event": event,
+                 "elapsed_s": round(time.monotonic() - _T_START, 1),
+                 **extra})
+    try:
+        from mxnet_tpu.telemetry import watchdog
+        watchdog.beat(f"bench:{name}")
+    except Exception:
+        pass
+
+
 def _pin_platform():
     """BENCH_r05 fix part 1: pin the jax backend BEFORE it initializes.
     The bench driver's host has no locally attached chip — the default
@@ -936,6 +951,76 @@ def _checkpoint_lane():
     return out
 
 
+def _telemetry_lane():
+    """Step-telemetry overhead A/B (mxnet_tpu.telemetry, ISSUE 6): the
+    checkpoint lane's MLP stepped with NO recorder vs with a live
+    StepLogger (registry histogram + counters per step) — steps/s each,
+    so the always-on observability cost is a measured number, not a
+    promise. Also times one /metrics scrape against the in-process
+    exporter while the registry is hot."""
+    import urllib.request
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import DataParallelTrainer, data_parallel_mesh
+    from mxnet_tpu.telemetry import StepLogger, start_server
+
+    n = min(2, len(jax.devices()))
+    mesh = data_parallel_mesh(n, jax.devices()[:n])
+    batch, dim, hidden = 256, 1024, 512
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="tlfc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="tlfc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, dim)).astype(np.float32)
+    y = rng.randint(0, 64, (batch,)).astype(np.float32)
+    steps = 32 if QUICK else 64
+
+    def _run(with_telemetry):
+        tr = DataParallelTrainer(sym, mesh, optimizer="sgd",
+                                 learning_rate=0.05, momentum=0.9,
+                                 rescale_grad=1.0 / batch,
+                                 dtype="float32")
+        params, states, aux = tr.init_state(
+            {"data": (batch, dim), "softmax_label": (batch,)})
+        inputs = tr.shard_inputs([x, y])
+        for _ in range(2):
+            params, states, aux, loss, _ = tr.step(params, states, aux,
+                                                   inputs)
+        float(loss)
+        slog = StepLogger("bench_telemetry") if with_telemetry else None
+        rates = []
+        try:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, states, aux, loss, _ = tr.step(
+                        params, states, aux, inputs)
+                    if slog is not None:
+                        slog.step(samples=batch)
+                float(loss)
+                rates.append(steps / (time.perf_counter() - t0))
+        finally:
+            if slog is not None:
+                slog.close()
+        return _median(rates)
+
+    base_sps = _run(False)
+    tele_sps = _run(True)
+    srv = start_server(0)
+    t0 = time.perf_counter()
+    body = urllib.request.urlopen(srv.url + "/metrics",
+                                  timeout=10).read().decode()
+    scrape_ms = (time.perf_counter() - t0) * 1e3
+    return {"baseline_steps_per_sec": round(base_sps, 2),
+            "telemetry_steps_per_sec": round(tele_sps, 2),
+            "overhead_pct": round((base_sps / tele_sps - 1.0) * 100, 2),
+            "scrape_ms": round(scrape_ms, 2),
+            "scrape_lines": body.count("\n"),
+            "devices": n}
+
+
 def main(argv=None):
     import argparse
 
@@ -959,15 +1044,34 @@ def main(argv=None):
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.parallel import data_parallel_mesh
+    # BENCH_r05 housekeeping: a driver kill at the budget should leave
+    # all-thread stacks on stderr, not rc=124 with zero evidence — arm
+    # one deadline dump just inside BENCH_BUDGET_S (cancelled on clean
+    # exit below)
+    from mxnet_tpu.telemetry import watchdog as _watchdog
+    _watchdog.dump_after(max(BENCH_BUDGET_S - 10.0, 30.0))
 
-    def _gated(est_s, fn, *fargs, **fkw):
+    def _gated(name, est_s, fn, *fargs, **fkw):
         """Run a secondary lane only when the remaining BENCH_BUDGET_S
         covers its estimated cost; shed (with the reason on record)
-        instead of letting the driver's timeout kill the whole run."""
+        instead of letting the driver's timeout kill the whole run.
+        Emits flushed lane_start/lane_end heartbeats so a killed run
+        names its last-live lane."""
         if _budget_left() < est_s:
             raise _BudgetExceeded(
                 f"budget: {_budget_left():.0f}s left < {est_s}s estimate")
-        return fn(*fargs, **fkw)
+        _heartbeat(name, "lane_start", est_s=est_s)
+        t0 = time.monotonic()
+        try:
+            out = fn(*fargs, **fkw)
+        except BaseException as e:
+            _heartbeat(name, "lane_end", ok=False,
+                       error=type(e).__name__,
+                       lane_s=round(time.monotonic() - t0, 1))
+            raise
+        _heartbeat(name, "lane_end", ok=True,
+                   lane_s=round(time.monotonic() - t0, 1))
+        return out
 
     sym = _resnet50_symbol()
     mesh = data_parallel_mesh(1, jax.devices())
@@ -975,6 +1079,7 @@ def main(argv=None):
     # -- training: bf16 multi-precision is the flagship lane (fp32 master
     # params, bf16 compute — the reference trains its fp16 configs the same
     # way, SURVEY §7); fp32 reported alongside ---------------------------------
+    _heartbeat("train_resnet50", "lane_start")
     fp32_ips = None if QUICK else _train_ips(sym, mesh, "float32")[0]
     (bf16_ips, step_flops, trainer, params, aux, x, y,
      single_step_ips) = _train_ips(sym, mesh, "bfloat16", want_flops=True)
@@ -988,6 +1093,7 @@ def main(argv=None):
                              if fp32_ips is not None else None})
 
     # -- inference (exact baseline config: batch 32), fp32 and bf16 ----------
+    _heartbeat("inference_resnet50", "lane_start")
     from mxnet_tpu.executor import _build_runner
     run = _build_runner(sym, is_train=False)
     arg_names = sym.list_arguments()
@@ -1023,8 +1129,8 @@ def main(argv=None):
         # apples-to-apples with the published K80 ResNet-152 row
         # (README.md:311, batch/GPU 32 — we use 64 for lane fill)
         rn152_ips, rn152_unit_flops = _gated(
-            90, _train_ips_quick, _resnet152_symbol(), mesh, "bfloat16",
-            batch=64)
+            "train_resnet152", 90, _train_ips_quick, _resnet152_symbol(),
+            mesh, "bfloat16", batch=64)
         rn152_ips = round(rn152_ips, 2)
         rn152_mfu = _mfu(rn152_ips, rn152_unit_flops)
     except _BudgetExceeded:
@@ -1034,7 +1140,7 @@ def main(argv=None):
     _emit("train_resnet152", {"ips_b64": rn152_ips, "mfu": rn152_mfu})
     try:
         lstm_tps, lstm_unit_flops, lstm_single_tps = _gated(
-            60, _lstm_tokens_per_sec, mesh)
+            "lstm_lm", 60, _lstm_tokens_per_sec, mesh)
         lstm_tps = round(lstm_tps, 0)
         lstm_single_tps = round(lstm_single_tps, 0)
         lstm_mfu = _mfu(lstm_tps, lstm_unit_flops)
@@ -1045,7 +1151,8 @@ def main(argv=None):
         lstm_single_tps = None
     _emit("lstm_lm", {"tokens_per_sec": lstm_tps, "mfu": lstm_mfu})
     try:
-        fa_tps, fa_unit_flops = _gated(45, _flash_attention_tokens_per_sec)
+        fa_tps, fa_unit_flops = _gated("flash_attention_seq4096", 45,
+                                       _flash_attention_tokens_per_sec)
         fa_tps = round(fa_tps, 0)
         fa_mfu = _mfu(fa_tps, fa_unit_flops)
     except _BudgetExceeded:
@@ -1058,7 +1165,7 @@ def main(argv=None):
         # long-context lane (r5): seq 8192, auto 512-blocks — the curve
         # through 32k is in docs/ROUND5.md (tools/attention_sweep.py)
         fa8_tps, fa8_unit_flops = _gated(
-            45, _flash_attention_tokens_per_sec,
+            "flash_attention_seq8192", 45, _flash_attention_tokens_per_sec,
             batch=2, heads=8, seq=8192, dim=128)
         fa8_tps = round(fa8_tps, 0)
         fa8_mfu = _mfu(fa8_tps, fa8_unit_flops)
@@ -1069,14 +1176,16 @@ def main(argv=None):
     _emit("flash_attention_seq8192", {"tokens_per_sec": fa8_tps,
                                       "mfu": fa8_mfu})
     try:
-        int8_ips = round(_gated(120, _int8_inference_ips, sym), 2)
+        int8_ips = round(_gated("int8_inference", 120,
+                                _int8_inference_ips, sym), 2)
     except _BudgetExceeded:
         int8_ips = "skipped: budget"
     except Exception as e:
         int8_ips = f"unavailable: {type(e).__name__}"
     _emit("int8_inference", {"b32_ips": int8_ips})
     try:
-        e2e_ips, pipe_ips = _gated(120, _e2e_data_lane, sym, mesh)
+        e2e_ips, pipe_ips = _gated("e2e_data", 120, _e2e_data_lane, sym,
+                                   mesh)
         e2e_ips, pipe_ips = round(e2e_ips, 1), round(pipe_ips, 1)
     except _BudgetExceeded:
         e2e_ips, pipe_ips = "skipped: budget", None
@@ -1088,14 +1197,14 @@ def main(argv=None):
     # but gated like every secondary lane so a tight budget sheds them
     # with the reason on record instead of eating the driver timeout
     try:
-        pipeline_lane = _gated(90, _pipeline_lane)
+        pipeline_lane = _gated("pipeline", 90, _pipeline_lane)
     except _BudgetExceeded:
         pipeline_lane = {"status": "skipped: budget"}
     except Exception as e:
         pipeline_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("pipeline", pipeline_lane)
     try:
-        cache_lane = _gated(60, _compile_cache_lane)
+        cache_lane = _gated("compile_cache", 60, _compile_cache_lane)
     except _BudgetExceeded:
         cache_lane = {"status": "skipped: budget"}
     except Exception as e:
@@ -1103,7 +1212,7 @@ def main(argv=None):
     _emit("compile_cache", cache_lane)
     # mixed-precision A/B + half-width all-reduce wire bytes (ISSUE 4)
     try:
-        amp_lane = _gated(90, _amp_lane)
+        amp_lane = _gated("amp", 90, _amp_lane)
     except _BudgetExceeded:
         amp_lane = {"status": "skipped: budget"}
     except Exception as e:
@@ -1112,12 +1221,20 @@ def main(argv=None):
     # fault-tolerant checkpointing A/B: none vs sync vs async commit
     # cadence, restore latency, bytes per commit (ISSUE 5)
     try:
-        ckpt_lane = _gated(90, _checkpoint_lane)
+        ckpt_lane = _gated("checkpoint", 90, _checkpoint_lane)
     except _BudgetExceeded:
         ckpt_lane = {"status": "skipped: budget"}
     except Exception as e:
         ckpt_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("checkpoint", ckpt_lane)
+    # step-telemetry overhead A/B + /metrics scrape latency (ISSUE 6)
+    try:
+        tele_lane = _gated("telemetry", 60, _telemetry_lane)
+    except _BudgetExceeded:
+        tele_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        tele_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("telemetry", tele_lane)
     acc_fail = None
     try:
         # the accuracy lane ASSERTS its target — never shed silently in a
@@ -1126,7 +1243,7 @@ def main(argv=None):
         if QUICK:
             acc_lane = "skipped: quick"
         else:
-            acc_lane = round(_gated(180, _accuracy_lane), 4)
+            acc_lane = round(_gated("accuracy", 180, _accuracy_lane), 4)
     except _BudgetExceeded:
         acc_lane = "skipped: budget"
     except AssertionError as e:
@@ -1216,10 +1333,16 @@ def main(argv=None):
         "checkpoint_restore_ms": ckpt_lane.get("restore_ms"),
         "checkpoint_bytes_per_commit": ckpt_lane.get(
             "ckpt_bytes_per_commit"),
+        # step telemetry (ISSUE 6): recorder-on overhead vs bare loop +
+        # /metrics scrape latency (full payload streamed above)
+        "telemetry_overhead_pct": tele_lane.get(
+            "overhead_pct", tele_lane.get("status")),
+        "telemetry_scrape_ms": tele_lane.get("scrape_ms"),
         "timing": "median-of-3x80-steps (20 dispatches x K=4)",
         "secondary_lane_timing": "median-of-3 windows: rn152 10 steps, "
                                  "lstm 64 steps (4xK=16), attn 10 steps",
     }))
+    _watchdog.cancel_deadline()
     if acc_fail:
         raise SystemExit(f"bench FAILED: {acc_fail}")
 

@@ -236,3 +236,9 @@ def _reset_for_tests():
         _cast_bytes_saved[0] = 0
         _scale_sources[:] = []
     _tls.loss_scale = None
+
+# register the export hook at import, not just amp.init(): the telemetry
+# registry absorbs every profiler hook at /metrics scrape time, and amp's
+# enabled/dtype/cast-savings counters should be visible (zeroed) even on
+# runs that never turn amp on
+_ensure_counter_export()

@@ -438,12 +438,22 @@ class CheckpointManager:
         os.replace(staging, final)
         _fsync_dir(self.directory)
         _maybe_crash("post-rename", step)
+        save_s = time.perf_counter() - t0
         with self._cond:
             self._counters["ckpt_commits"] += 1
             self._counters["ckpt_bytes"] += nbytes
-            self._counters["ckpt_save_us"] += int(
-                (time.perf_counter() - t0) * 1e6)
+            self._counters["ckpt_save_us"] += int(save_s * 1e6)
             self._counters["ckpt_last_step"] = int(step)
+        try:
+            # native registry distribution alongside the cumulative
+            # profiler counter (telemetry absorbs the latter already)
+            from ..telemetry import histogram
+            histogram("mxnet_checkpoint_save_seconds",
+                      help="wall time per committed checkpoint "
+                           "(capture+serialize+fsync+rename)"). \
+                observe(save_s)
+        except Exception:                       # pragma: no cover
+            pass
         self._apply_retention()
 
     def _load_validated(self, path):

@@ -84,6 +84,19 @@ _DOCUMENTED = {
     "MXNET_CHECKPOINT_ASYNC": 1,
     "MXNET_CHECKPOINT_KEEP": 3,
     "MXNET_CHECKPOINT_BEST_K": 0,
+    # unified telemetry (mxnet_tpu.telemetry, docs/TELEMETRY.md):
+    # MXNET_TELEMETRY=0 disables step recording (watchdog beats remain);
+    # MXNET_TELEMETRY_PORT=<port> starts the /metrics + /healthz HTTP
+    # exporter at import; MXNET_TELEMETRY_LOG=<path> appends JSONL
+    # run_start/step/run_end records; MXNET_TELEMETRY_STALL_S=<seconds>
+    # (float string — default unset) arms the stall watchdog that dumps
+    # all-thread stacks when no training step lands for that long;
+    # MXNET_TELEMETRY_STALL_PATH additionally appends dumps to a file
+    "MXNET_TELEMETRY": 1,
+    "MXNET_TELEMETRY_PORT": None,
+    "MXNET_TELEMETRY_LOG": None,
+    "MXNET_TELEMETRY_STALL_S": None,
+    "MXNET_TELEMETRY_STALL_PATH": None,
 }
 
 
@@ -161,6 +174,16 @@ def _apply_startup():
     if get("MXNET_PROFILER_AUTOSTART"):
         from . import profiler
         profiler.set_state("run")
+    port = get("MXNET_TELEMETRY_PORT")
+    if port not in (None, ""):
+        from . import telemetry
+        try:
+            telemetry.start_server(int(port))
+        except (ValueError, OSError):
+            pass                      # bad port / port in use: no exporter
+    if get("MXNET_TELEMETRY_STALL_S") not in (None, ""):
+        from .telemetry import watchdog
+        watchdog.install()
     # Join the distributed job NOW if launched by tools/launch.py:
     # jax.distributed.initialize must run before any XLA backend use, and
     # user scripts create arrays long before they reach

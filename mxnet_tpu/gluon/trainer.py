@@ -181,6 +181,11 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
 
     k = int(steps_per_dispatch)
     epoch_losses = []
+    from ..telemetry import maybe_step_logger
+    slog = maybe_step_logger("gluon_fused_fit", meta={
+        "optimizer": optimizer, "steps_per_dispatch": k,
+        "batch_size": batch, "num_epoch": num_epoch,
+        "amp_dtype": dtype if dtype != "float32" else None})
     try:
         for epoch in range(begin_epoch, num_epoch):
             total, count = 0.0, 0
@@ -198,8 +203,14 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
                 for inputs, n_blk in feed:
                     params, states, aux, losses, _ = trainer.step_k(
                         params, states, aux, inputs)
-                    total += float(np.sum(np.asarray(losses)))
+                    blk_loss = float(np.sum(np.asarray(losses)))
+                    total += blk_loss
                     count += n_blk * batch
+                    # the np.asarray above already synced on the block's
+                    # losses, so this wall time covers real device work
+                    slog.step(samples=n_blk * batch, steps=n_blk,
+                              loss=blk_loss / max(n_blk * batch, 1),
+                              extra={"epoch": epoch})
                     nbatch += n_blk
                     gstep += n_blk
                     if ckpt_mgr is not None:
@@ -234,6 +245,7 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
                     ckpt_mgr.wait()
                     raise SystemExit(143)
     finally:
+        slog.close()
         if ckpt_mgr is not None:
             ckpt_mgr.remove_sigterm_hook()
             ckpt_mgr.close()

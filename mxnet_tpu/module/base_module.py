@@ -283,6 +283,15 @@ class BaseModule:
         epoch_callbacks = _as_list(epoch_end_callback)
 
         from ..pipeline import feed_or_inline, close_feed, module_stage
+        # step telemetry (docs/TELEMETRY.md): wall time / samples/s per
+        # step into the registry + optional JSONL event log, and a
+        # liveness beat for the stall watchdog. MXNET_TELEMETRY=0 swaps
+        # in the null recorder (watchdog beats only).
+        from ..telemetry import maybe_step_logger
+        slog = maybe_step_logger("module_fit", meta={
+            "optimizer": optimizer if isinstance(optimizer, str)
+            else type(optimizer).__name__,
+            "begin_epoch": begin_epoch, "num_epoch": num_epoch})
 
         def _ckpt_save(next_epoch, next_batch, metric_val=None,
                        blocking=None):
@@ -344,6 +353,10 @@ class BaseModule:
                                                      locals=locals())
                             for callback in batch_callbacks:
                                 callback(cb_param)
+                        slog.step(
+                            samples=int(data_batch.data[0].shape[0])
+                            if data_batch.data else None,
+                            extra={"epoch": epoch})
                         data_batch = upcoming
                         nbatch += 1
                         gstep += 1
@@ -392,6 +405,7 @@ class BaseModule:
 
                 train_data.reset()
         finally:
+            slog.close()
             if ckpt_mgr is not None:
                 ckpt_mgr.remove_sigterm_hook()
                 ckpt_mgr.close()

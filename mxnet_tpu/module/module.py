@@ -486,6 +486,12 @@ class Module(BaseModule):
 
         from ..base import to_numpy as _np_of
         from ..pipeline import feed_or_inline, close_feed
+        from ..telemetry import maybe_step_logger
+        slog = maybe_step_logger("module_fit_fused", meta={
+            "optimizer": optimizer, "steps_per_dispatch": int(k),
+            "batch_size": int(batch_size), "begin_epoch": begin_epoch,
+            "num_epoch": num_epoch,
+            "amp_dtype": fit_dtype if fit_dtype != "float32" else None})
         data_idx = {n: i for i, n in enumerate(self._data_names)}
         label_idx = {n: i for i, n in enumerate(self._label_names)}
 
@@ -563,6 +569,11 @@ class Module(BaseModule):
                         label_dict = {name: NDArray(v)
                                       for name, v in label_np.items()}
                         eval_metric.update_dict(label_dict, pred_dict)
+                        # one record per fused dispatch (K steps); the
+                        # metric update above already synced on outputs,
+                        # so the wall time covers real device work
+                        slog.step(samples=n_blk * batch_size,
+                                  steps=n_blk, extra={"epoch": epoch})
                         nbatch += n_blk
                         gstep += n_blk
                         if batch_callbacks:
@@ -627,6 +638,7 @@ class Module(BaseModule):
                                          epoch, name, val)
                 train_data.reset()
         finally:
+            slog.close()
             if ckpt_mgr is not None:
                 ckpt_mgr.remove_sigterm_hook()
                 ckpt_mgr.close()

@@ -65,6 +65,7 @@ def stats():
     stage = out["feed_stage_us"]
     out["overlap_frac"] = round(
         max(0.0, 1.0 - out["feed_wait_us"] / stage), 4) if stage else 0.0
+    out["feeds_active"] = out["feeds_opened"] - out["feeds_closed"]
     return out
 
 

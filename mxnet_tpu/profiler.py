@@ -25,7 +25,8 @@ import threading
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state", "Domain", "Task",
            "Counter", "Marker", "Frame", "register_counter_export",
-           "unregister_counter_export", "export_counters"]
+           "unregister_counter_export", "export_counters",
+           "export_counter"]
 
 _lock = threading.Lock()
 _state = "stop"
@@ -192,6 +193,20 @@ def unregister_counter_export(name):
         _counter_exports.pop(name, None)
 
 
+def export_counter(name):
+    """Snapshot ONE registered hook (or None): lets a consumer poll a
+    single subsystem (telemetry.StepLogger reads "checkpoint" per step)
+    without triggering every other hook's snapshot cost."""
+    with _lock:
+        fn = _counter_exports.get(name)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:                           # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def export_counters(format="dict"):
     """Snapshot every registered counter hook: {name: fn()}.
     A hook that raises is reported as {"error": ...} rather than taking
@@ -297,6 +312,12 @@ class Counter:
 
     def set_value(self, value):
         self.value = value
+        # gate on is_running() like spans do: long-lived counters
+        # (serving queue depth/shed) tick on every request, and recording
+        # while stopped/paused grew _events without bound on a server
+        # that never profiles
+        if not is_running():
+            return
         with _lock:
             _events.append({"name": self.name, "ph": "C",
                             "ts": time.perf_counter() * 1e6, "pid": 0,
@@ -315,6 +336,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
+        if not is_running():            # same gate as spans/counters
+            return
         with _lock:
             _events.append({"name": self.name, "ph": "i",
                             "ts": time.perf_counter() * 1e6, "pid": 0,

@@ -124,6 +124,10 @@ def selftest(path=None, requests=256, concurrency=8, max_wait_us=2000,
                         queue_depth=queue_depth) as bat:
         bat_qps = _batched_qps(bat, sample, requests, concurrency)
         snap = bat.metrics.snapshot()
+        # closed-loop observability check: scrape our own /metrics while
+        # the batcher is still live and confirm the serving counters made
+        # it through the registry -> Prometheus path
+        scrape = _self_scrape(bat.metrics.name)
     speedup = bat_qps / seq_qps if seq_qps else float("inf")
     return {
         "metric": "serving_selftest",
@@ -142,8 +146,31 @@ def selftest(path=None, requests=256, concurrency=8, max_wait_us=2000,
         "batch_hist": snap["batch_hist"],
         "shed": snap["shed"],
         "timeouts": snap["timeouts"],
-        "ok": speedup >= min_speedup,
+        "telemetry_port": scrape["port"],
+        "telemetry_scrape_ok": scrape["ok"],
+        "ok": speedup >= min_speedup and scrape["ok"],
     }
+
+
+def _self_scrape(metrics_name):
+    """Start (or reuse) the telemetry exporter, GET /metrics, and verify
+    this batcher's completed/qps/p50/p99/shed counters are present in
+    Prometheus text form. Returns {"port", "ok", "missing"}."""
+    import urllib.request
+    from ..telemetry import start_server
+    mname = metrics_name.replace("#", "_")
+    expect = [f"mxnet_{mname}_{k}" for k in
+              ("completed", "qps", "p50_ms", "p99_ms", "shed",
+               "queue_depth")] + \
+             [f"mxnet_{mname}_request_latency_seconds_bucket"]
+    try:
+        srv = start_server()
+        body = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=10).read().decode()
+        missing = [e for e in expect if e not in body]
+        return {"port": srv.port, "ok": not missing, "missing": missing}
+    except Exception as e:                       # pragma: no cover
+        return {"port": None, "ok": False, "missing": [repr(e)]}
 
 
 def main(argv=None):

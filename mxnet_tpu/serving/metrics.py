@@ -14,6 +14,12 @@ Two consumption paths, same numbers:
 Latency percentiles come from a bounded reservoir of the most recent
 `latency_window` request latencies (deque ring) — O(1) record, exact
 percentiles over the window, no unbounded growth under sustained load.
+
+The telemetry registry (mxnet_tpu.telemetry) absorbs the snapshot hook,
+so every field here appears at /metrics as `mxnet_serving_*`; queue
+depth and request latency additionally feed a native registry gauge /
+histogram so Prometheus sees a real cumulative-bucket distribution, not
+just the window percentiles.
 """
 from __future__ import annotations
 
@@ -52,6 +58,15 @@ class ServingMetrics:
         self._c_depth = dom.new_counter("queue_depth")
         self._c_shed = dom.new_counter("shed_total")
         profiler.register_counter_export(self.name, self.snapshot)
+        # native registry series ("#2" -> "_2" for metric-name legality)
+        from ..telemetry import gauge, histogram
+        mname = self.name.replace("#", "_")
+        self._g_depth = gauge(
+            f"mxnet_{mname}_queue_depth",
+            help="live dynamic-batcher queue size")
+        self._h_lat = histogram(
+            f"mxnet_{mname}_request_latency_seconds",
+            help="submit-to-resolve request latency")
 
     def close(self):
         profiler.unregister_counter_export(self.name)
@@ -78,6 +93,7 @@ class ServingMetrics:
     def record_queue_depth(self, depth):
         with self._lock:
             self.queue_depth = depth
+        self._g_depth.set(depth)
         if profiler.is_running():
             self._c_depth.set_value(depth)
 
@@ -91,6 +107,7 @@ class ServingMetrics:
         with self._lock:
             self.completed += 1
             self._lat.append(latency_s)
+        self._h_lat.observe(latency_s)
 
     # -- reading ------------------------------------------------------------
 

@@ -1,0 +1,41 @@
+"""mxnet_tpu.telemetry — unified observability for the whole framework.
+
+Beyond-reference subsystem (docs/TELEMETRY.md). Four pieces:
+
+  - **registry** (registry.py): always-on Counter/Gauge/Histogram store,
+    host-side only (no device syncs), that additionally absorbs every
+    `profiler.register_counter_export` hook — serving, device_feed,
+    checkpoint, amp — so all subsystem counters flow through one place.
+    `profiler.dump()` keeps embedding the merged snapshot (the registry
+    exports itself back as the "telemetry" hook).
+  - **exporter** (exporter.py): stdlib HTTP server; Prometheus text
+    exposition at `/metrics`, JSON `/healthz`.
+    `telemetry.start_server(port)` or `MXNET_TELEMETRY_PORT=<port>`.
+  - **step telemetry** (steplog.py): `StepLogger` threaded through
+    BaseModule.fit / Module._fit_fused / gluon fused_fit — per-step wall
+    time, samples/s, loss, amp scale/skips, DeviceFeed overlap,
+    checkpoint save/wait time; JSONL event log via
+    `MXNET_TELEMETRY_LOG=<path>`; `MXNET_TELEMETRY=0` turns recording off.
+  - **hang diagnostics** (watchdog.py): stall watchdog
+    (`MXNET_TELEMETRY_STALL_S`) dumping all-thread stacks when a step
+    stalls, SIGUSR1 on-demand dumps, and deadline dumps for budgeted
+    harnesses (bench.py).
+
+Selftest: `python -m mxnet_tpu.telemetry --selftest` runs a short fit
+with the server up, scrapes itself, asserts every subsystem's counters
+appear, A/B-checks telemetry-on vs -off overhead (< 2%) with bit-identical
+params, and proves the stall watchdog dumps stacks.
+"""
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, Histogram, Registry, counter, gauge,
+                       get_registry, histogram)
+from .exporter import TelemetryServer, get_server, start_server, stop_server
+from .steplog import StepLogger, enabled, maybe_step_logger
+from . import watchdog
+from .watchdog import install as install_watchdog
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "counter", "gauge",
+           "histogram", "get_registry", "TelemetryServer", "start_server",
+           "stop_server", "get_server", "StepLogger", "maybe_step_logger",
+           "enabled", "watchdog", "install_watchdog"]

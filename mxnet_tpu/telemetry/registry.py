@@ -1,0 +1,358 @@
+"""Metrics registry — the always-on, process-wide observability store.
+
+Three metric types, Prometheus-shaped:
+
+  - ``Counter``   monotonically increasing total (requests served, steps run)
+  - ``Gauge``     point-in-time value that can go either way (queue depth)
+  - ``Histogram`` bounded-bucket distribution (step latency): a fixed tuple
+    of upper bounds, one int cell per bucket plus +Inf, running sum/count —
+    O(log buckets) per observe, O(1) memory forever.
+
+Everything is host-side python ints/floats behind one small lock per
+metric: recording NEVER touches the device, never syncs, never allocates
+beyond the first registration — safe on the training hot path.
+
+The registry also *absorbs* the profiler's counter-export hooks
+(`profiler.register_counter_export` — serving, device_feed, checkpoint,
+amp register themselves there): `render_prometheus()` snapshots every
+hook and flattens its numeric fields into `mxnet_<hook>_<key>` gauges, so
+one `/metrics` scrape carries every subsystem without any of them having
+to know telemetry exists. The flow is bidirectional: the registry's own
+metrics are exported back through a ``"telemetry"`` profiler hook, so
+`profiler.dump()` keeps embedding the merged snapshot exactly as before
+(backward compat with the pre-telemetry counter surface).
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
+           "counter", "gauge", "histogram"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name):
+    """Prometheus metric-name charset ([a-zA-Z_:][a-zA-Z0-9_:]*)."""
+    name = _NAME_RE.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class _Metric:
+    """Shared shell: name, help text, one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = _sanitize(name)
+        self.help = help
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic total. `inc` only — a counter that goes down is a gauge."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"Counter {self.name}: inc by negative {n}")
+        with self._lock:
+            self._value += n
+
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _render(self):
+        return [f"{self.name} {_fmt(self.value())}"]
+
+    def _snapshot(self):
+        return self.value()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _render(self):
+        return [f"{self.name} {_fmt(self.value())}"]
+
+    def _snapshot(self):
+        return self.value()
+
+
+# Latency-flavored default bounds (seconds): sub-ms serving hops through
+# multi-minute stalls. 17 buckets — the whole histogram is ~20 machine
+# words, bounded forever.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram(_Metric):
+    """Fixed-bound bucket histogram (Prometheus semantics: `le` upper
+    bounds, cumulative at render time, +Inf implicit last)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=None):
+        super().__init__(name, help)
+        bounds = tuple(sorted(float(b) for b in (buckets or
+                                                 DEFAULT_BUCKETS)))
+        if not bounds:
+            raise ValueError(f"Histogram {self.name}: needs >=1 bucket")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)      # last cell = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"buckets": dict(zip(self.bounds, self._counts)),
+                    "inf": self._counts[-1], "sum": self._sum,
+                    "count": self._count}
+
+    def percentile(self, p):
+        """Bucket-resolution percentile estimate (upper bound of the
+        bucket holding the p-th sample); None when empty. Exact enough
+        for healthz/step summaries — /metrics exports the raw buckets so
+        real quantiles happen server-side."""
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if not total:
+            return None
+        target = max(1, math.ceil(p / 100.0 * total))
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return self.bounds[i] if i < len(self.bounds) \
+                    else float("inf")
+        return float("inf")
+
+    def _render(self):
+        with self._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._count
+        lines = []
+        acc = 0
+        for bound, c in zip(self.bounds, counts):
+            acc += c
+            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {acc}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
+        lines.append(f"{self.name}_sum {_fmt(s)}")
+        lines.append(f"{self.name}_count {n}")
+        return lines
+
+    def _snapshot(self):
+        snap = self.snapshot()
+        snap["p50"] = self.percentile(50)
+        snap["p99"] = self.percentile(99)
+        return {"count": snap["count"], "sum": round(snap["sum"], 6),
+                "p50": snap["p50"], "p99": snap["p99"]}
+
+
+def _fmt(v):
+    """Prometheus float formatting: integers render bare, floats use
+    repr (full precision), non-finite use +Inf/-Inf/NaN."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class Registry:
+    """Name -> metric store. `counter/gauge/histogram` are get-or-create
+    (same name + same kind returns the existing instance, so any module
+    can grab a handle without coordination; a kind clash raises)."""
+
+    def __init__(self, absorb_profiler=True):
+        self._lock = threading.Lock()
+        self._metrics = {}          # insertion-ordered
+        self._absorb = absorb_profiler
+
+    # -- creation -----------------------------------------------------------
+
+    def _get_or_create(self, cls, name, help, **kw):
+        name = _sanitize(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"requested {cls.kind}")
+                return m
+            m = cls(name, help=help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=None):
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(_sanitize(name), None)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(_sanitize(name))
+
+    # -- reading ------------------------------------------------------------
+
+    def own_metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self):
+        """{name: value-or-histogram-summary} of the registry's NATIVE
+        metrics only — this is what flows back into profiler.dump() via
+        the "telemetry" counter-export hook (no recursion: absorbed
+        hooks are not re-exported)."""
+        return {m.name: m._snapshot() for m in self.own_metrics()}
+
+    def absorbed(self):
+        """Snapshot of every profiler counter-export hook except our own
+        "telemetry" back-export. {} when absorption is off or the
+        profiler is unavailable."""
+        if not self._absorb:
+            return {}
+        try:
+            from .. import profiler
+            out = profiler.export_counters()
+        except Exception:               # pragma: no cover
+            return {}
+        out.pop("telemetry", None)
+        return out
+
+    def render_prometheus(self):
+        """The /metrics payload (text exposition format 0.0.4): native
+        metrics first with HELP/TYPE headers, then every absorbed
+        profiler hook flattened to `mxnet_<hook>_<key>` gauges (nested
+        one-level dicts become labeled series, e.g. serving's
+        batch_hist{bucket="8"}). Native names win a collision — a
+        subsystem exporting through BOTH paths is listed once."""
+        lines = []
+        seen = set()
+        for m in self.own_metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._render())
+            seen.add(m.name)
+        for hook, snap in sorted(self.absorbed().items()):
+            if not isinstance(snap, dict):
+                continue
+            prefix = _sanitize(f"mxnet_{hook}")
+            for key, val in snap.items():
+                name = _sanitize(f"{prefix}_{key}")
+                if name in seen:
+                    continue
+                if isinstance(val, dict):
+                    series = [(str(k), v) for k, v in sorted(val.items())
+                              if isinstance(v, (int, float))
+                              and not isinstance(v, bool)]
+                    if not series:
+                        continue
+                    seen.add(name)
+                    lines.append(f"# TYPE {name} gauge")
+                    for k, v in series:
+                        k = k.replace("\\", "\\\\").replace('"', '\\"')
+                        lines.append(f'{name}{{bucket="{k}"}} {_fmt(v)}')
+                elif isinstance(val, (int, float, bool)):
+                    seen.add(name)
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f"{name} {_fmt(val)}")
+                # strings/None/other: not a metric; JSON consumers get
+                # them via profiler.export_counters()
+        return "\n".join(lines) + "\n"
+
+    def _reset_for_tests(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_default = Registry()
+_hook_registered = [False]
+
+
+def _ensure_profiler_backexport():
+    """Register the registry's native snapshot as a profiler counter
+    hook, so profiler.dump()/export_counters() carry step histograms and
+    telemetry counters alongside the legacy subsystem hooks."""
+    if _hook_registered[0]:
+        return
+    try:
+        from .. import profiler
+        profiler.register_counter_export("telemetry", _default.snapshot)
+        _hook_registered[0] = True
+    except Exception:                   # pragma: no cover
+        pass
+
+
+def get_registry():
+    _ensure_profiler_backexport()
+    return _default
+
+
+def counter(name, help=""):
+    return get_registry().counter(name, help=help)
+
+
+def gauge(name, help=""):
+    return get_registry().gauge(name, help=help)
+
+
+def histogram(name, help="", buckets=None):
+    return get_registry().histogram(name, help=help, buckets=buckets)

@@ -1,0 +1,230 @@
+"""Step-level training telemetry.
+
+A `StepLogger` rides every training loop (BaseModule.fit per-batch,
+Module._fit_fused, gluon fused_fit) and records, per step (or per fused
+K-step block): wall time, samples/s, loss when the loop already has it on
+host, the amp loss-scale / skipped-step count, the DeviceFeed overlap
+fraction, and the checkpoint save/wait time accrued since the last step.
+
+Two sinks, both cheap:
+  - the registry (`mxnet_step_time_seconds` histogram,
+    `mxnet_steps_total` / `mxnet_samples_total` counters,
+    `mxnet_step_loss` / `mxnet_samples_per_second` gauges) — scrapeable
+    live at /metrics;
+  - a structured JSONL event log when `MXNET_TELEMETRY_LOG=<path>` is
+    set (`run_start` / `step` / `run_end` records, one JSON object per
+    line, flushed per write so a crash loses at most the in-flight line).
+
+Hot-path discipline: no device syncs originate here. Loss is only
+recorded when the loop passes an already-host-side float; amp counters
+are sampled only while amp is enabled (the fused loops have already
+synchronized on the loss/metric by the time step() runs); DeviceFeed and
+checkpoint counters are plain host dicts. Every step() also beats the
+stall watchdog, so an armed watchdog learns liveness for free.
+
+`MXNET_TELEMETRY=0` swaps in the `_NullStepLogger` (still beats the
+watchdog; records nothing) — the A/B the selftest and bench's telemetry
+lane measure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import watchdog as _watchdog
+from .registry import counter, gauge, histogram
+
+__all__ = ["StepLogger", "maybe_step_logger", "enabled"]
+
+# step durations: 100us host-bound micro-steps through multi-minute
+# stalls (the watchdog owns anything beyond)
+STEP_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                60.0, 120.0)
+
+
+def enabled():
+    """MXNET_TELEMETRY master gate (default on)."""
+    from .. import config
+    return bool(config.get("MXNET_TELEMETRY", 1))
+
+
+def _log_path():
+    from .. import config
+    return config.get("MXNET_TELEMETRY_LOG") or None
+
+
+class _NullStepLogger:
+    """Telemetry-off stand-in: same surface, records nothing, still
+    beats the watchdog (hang diagnostics stay armed without metrics)."""
+
+    def step(self, samples=None, loss=None, steps=1, extra=None):
+        _watchdog.beat()
+
+    def close(self, **extra):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class StepLogger:
+    """Per-loop telemetry recorder. One instance per fit call.
+
+    step(samples=, loss=, steps=K): record one dispatch — K fused steps
+    ran in it (K=1 on per-batch paths), `samples` rows were consumed,
+    `loss` is an optional host-side float the loop already had. Wall
+    time is measured here (time since the previous step()/construction),
+    so the loop adds exactly one call per dispatch.
+    """
+
+    def __init__(self, phase, meta=None, registry_prefix="mxnet"):
+        self.phase = str(phase)
+        self._lock = threading.Lock()
+        self._t_last = time.perf_counter()
+        self._t0 = self._t_last
+        self._n = 0
+        self._samples = 0
+        self._file = None
+        p = registry_prefix
+        self._h_step = histogram(
+            f"{p}_step_time_seconds",
+            help="per-training-step wall time (fused blocks record "
+                 "block_time/K per step)", buckets=STEP_BUCKETS)
+        self._c_steps = counter(f"{p}_steps_total",
+                                help="training steps completed")
+        self._c_samples = counter(f"{p}_samples_total",
+                                  help="training samples consumed")
+        self._g_loss = gauge(f"{p}_step_loss",
+                             help="last host-reported training loss")
+        self._g_rate = gauge(f"{p}_samples_per_second",
+                             help="instantaneous training throughput")
+        # subsystem counter baselines for per-step deltas
+        self._ckpt_last = self._ckpt_counters()
+        path = _log_path()
+        if path:
+            try:
+                self._file = open(path, "a", encoding="utf-8")
+            except OSError:
+                self._file = None
+        self._emit({"event": "run_start", "phase": self.phase,
+                    "pid": os.getpid(), **(meta or {})})
+
+    # -- subsystem sampling (host dicts only) -------------------------------
+
+    @staticmethod
+    def _ckpt_counters():
+        from .. import profiler
+        c = profiler.export_counter("checkpoint")
+        if not isinstance(c, dict):
+            return {"ckpt_save_us": 0, "ckpt_wait_us": 0}
+        return {"ckpt_save_us": int(c.get("ckpt_save_us", 0)),
+                "ckpt_wait_us": int(c.get("ckpt_wait_us", 0))}
+
+    @staticmethod
+    def _amp_sample():
+        from .. import amp
+        if not amp.is_enabled():
+            return None, 0
+        try:
+            c = amp.counters()
+            return c.get("amp_scale"), int(c.get("amp_skipped_steps", 0))
+        except Exception:               # pragma: no cover
+            return None, 0
+
+    @staticmethod
+    def _feed_overlap():
+        from .. import pipeline
+        try:
+            return pipeline.stats().get("overlap_frac")
+        except Exception:               # pragma: no cover
+            return None
+
+    # -- recording ----------------------------------------------------------
+
+    def step(self, samples=None, loss=None, steps=1, extra=None):
+        now = time.perf_counter()
+        _watchdog.beat(f"{self.phase} step")
+        with self._lock:
+            wall = now - self._t_last
+            self._t_last = now
+            self._n += int(steps)
+            n = self._n
+            if samples:
+                self._samples += int(samples)
+        per_step = wall / max(int(steps), 1)
+        self._h_step.observe(per_step)
+        self._c_steps.inc(int(steps))
+        if samples:
+            self._c_samples.inc(int(samples))
+            if wall > 0:
+                self._g_rate.set(round(samples / wall, 3))
+        if loss is not None:
+            self._g_loss.set(float(loss))
+        if self._file is None:
+            return
+        amp_scale, amp_skipped = self._amp_sample()
+        ckpt = self._ckpt_counters()
+        rec = {"event": "step", "phase": self.phase, "step": n,
+               "wall_s": round(wall, 6), "steps": int(steps),
+               "samples": int(samples) if samples else None,
+               "samples_per_s": round(samples / wall, 3)
+               if samples and wall > 0 else None,
+               "loss": float(loss) if loss is not None else None,
+               "amp_scale": amp_scale, "amp_skipped_steps": amp_skipped,
+               "feed_overlap_frac": self._feed_overlap(),
+               "ckpt_save_us": ckpt["ckpt_save_us"]
+               - self._ckpt_last["ckpt_save_us"],
+               "ckpt_wait_us": ckpt["ckpt_wait_us"]
+               - self._ckpt_last["ckpt_wait_us"]}
+        self._ckpt_last = ckpt
+        if extra:
+            rec.update(extra)
+        self._emit(rec)
+
+    def close(self, **extra):
+        wall = time.perf_counter() - self._t0
+        self._emit({"event": "run_end", "phase": self.phase,
+                    "steps": self._n, "samples": self._samples,
+                    "wall_s": round(wall, 6),
+                    "samples_per_s": round(self._samples / wall, 3)
+                    if wall > 0 and self._samples else None, **extra})
+        if self._file is not None:
+            try:
+                self._file.close()
+            finally:
+                self._file = None
+
+    def _emit(self, rec):
+        if self._file is None:
+            return
+        rec.setdefault("ts", round(time.time(), 3))
+        try:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        except (OSError, ValueError):   # disk full / closed file
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def maybe_step_logger(phase, meta=None):
+    """The training loops' entry point: a real StepLogger when telemetry
+    is on, the null recorder (watchdog beats only) when MXNET_TELEMETRY=0.
+    Never raises — a broken telemetry config must not take down fit."""
+    try:
+        if enabled():
+            return StepLogger(phase, meta=meta)
+    except Exception:                   # pragma: no cover
+        pass
+    return _NullStepLogger()

@@ -1,0 +1,326 @@
+"""mxnet_tpu.telemetry tests: registry semantics, Prometheus rendering,
+profiler-hook absorption (both directions), the HTTP exporter under a
+live fit, StepLogger JSONL, the stall watchdog, and the MXNET_TELEMETRY=0
+bit-identical contract. Plus the profiler Counter/Marker stopped-state
+gating fix that rode this PR."""
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.telemetry.registry import Registry, _fmt
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_concurrent_counter_exact():
+    reg = Registry(absorb_profiler=False)
+    c = reg.counter("t_total")
+    threads = [threading.Thread(
+        target=lambda: [c.inc() for _ in range(5000)]) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 40000
+
+
+def test_registry_get_or_create_and_kind_clash():
+    reg = Registry(absorb_profiler=False)
+    a = reg.counter("same_handle")
+    assert reg.counter("same_handle") is a
+    with pytest.raises(ValueError):
+        reg.gauge("same_handle")
+    with pytest.raises(ValueError):
+        a.inc(-1)           # counters are monotonic
+
+
+def test_histogram_buckets_and_percentile():
+    reg = Registry(absorb_profiler=False)
+    h = reg.histogram("t_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["buckets"][0.01] == 2 and snap["inf"] == 1
+    assert h.percentile(50) == 0.1
+    text = reg.render_prometheus()
+    # cumulative buckets + the implicit +Inf, sum, count
+    assert 't_seconds_bucket{le="0.01"} 2' in text
+    assert 't_seconds_bucket{le="0.1"} 3' in text
+    assert 't_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_seconds_count 5" in text
+
+
+def test_render_prometheus_line_format():
+    reg = Registry(absorb_profiler=False)
+    reg.counter("fmt_total", help="help text").inc(3)
+    reg.gauge("fmt_gauge").set(2.5)
+    reg.histogram("fmt_seconds", buckets=(1.0,)).observe(0.5)
+    line_re = re.compile(
+        r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*'
+        r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+        r'(-?\d+(\.\d+)?([eE]-?\d+)?|\+Inf|-Inf|NaN))$')
+    for line in reg.render_prometheus().strip().split("\n"):
+        assert line_re.match(line), f"malformed exposition line: {line!r}"
+    assert _fmt(float("inf")) == "+Inf" and _fmt(True) == "1"
+
+
+def test_registry_absorbs_profiler_hooks_and_dedups():
+    reg = Registry(absorb_profiler=True)
+    profiler.register_counter_export(
+        "t_sub", lambda: {"jobs": 7, "ratio": 0.5, "note": "str-skipped",
+                          "hist": {"8": 3, "16": 1}})
+    try:
+        text = reg.render_prometheus()
+        assert "mxnet_t_sub_jobs 7" in text
+        assert "mxnet_t_sub_ratio 0.5" in text
+        assert "note" not in text                   # non-numeric dropped
+        assert 'mxnet_t_sub_hist{bucket="8"} 3' in text
+        # native metric with the colliding name wins (single series)
+        reg.gauge("mxnet_t_sub_jobs").set(99)
+        samples = [ln for ln in reg.render_prometheus().splitlines()
+                   if ln.startswith("mxnet_t_sub_jobs ")]
+        assert samples == ["mxnet_t_sub_jobs 99"]
+    finally:
+        profiler.unregister_counter_export("t_sub")
+
+
+def test_registry_backexport_rides_profiler_dump(tmp_path):
+    telemetry.counter("mxnet_backexport_check_total").inc(4)
+    out = profiler.export_counters()
+    assert out["telemetry"]["mxnet_backexport_check_total"] == 4
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    path = profiler.dump()
+    trace = json.loads(open(path).read())
+    assert trace["counters"]["telemetry"][
+        "mxnet_backexport_check_total"] == 4
+
+
+def test_profiler_export_counter_single_hook():
+    profiler.register_counter_export("t_one", lambda: {"v": 1})
+    try:
+        assert profiler.export_counter("t_one") == {"v": 1}
+        assert profiler.export_counter("t_absent") is None
+    finally:
+        profiler.unregister_counter_export("t_one")
+
+
+# -- profiler gating satellite ----------------------------------------------
+
+def test_profiler_counter_marker_gated_when_stopped():
+    """set_value/mark while the profiler is stopped must not grow the
+    event buffer (long-lived serving counters tick on every request)."""
+    profiler.set_state("stop")
+    before = len(profiler._events)
+    dom = profiler.Domain("t_gate")
+    c = dom.new_counter("c", 1)
+    c.increment(5)
+    dom.new_marker("m").mark()
+    assert len(profiler._events) == before
+    assert c.value == 6                  # value tracking still works
+    profiler.set_state("run")
+    try:
+        c.increment()
+        dom.new_marker("m2").mark()
+        assert len(profiler._events) == before + 2
+    finally:
+        profiler.set_state("stop")
+        with profiler._lock:
+            profiler._events.clear()
+
+
+# -- exporter ----------------------------------------------------------------
+
+def test_exporter_scrape_during_live_fit(tmp_path):
+    """GET /metrics from a batch_end_callback — a scrape landing mid-fit
+    must see live step counters and not perturb training."""
+    from mxnet_tpu.telemetry.exporter import TelemetryServer
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(120, 8)).astype(np.float32)
+    Y = rng.randint(0, 4, size=(120,)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, Y, batch_size=40)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="tfc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    seen = []
+    with TelemetryServer(port=0) as srv:
+        def scrape_cb(param):
+            body = urllib.request.urlopen(srv.url + "/metrics",
+                                          timeout=10).read().decode()
+            seen.append(body)
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=1,
+                batch_end_callback=scrape_cb)
+        health = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=10).read().decode())
+    assert seen and "mxnet_step_time_seconds_bucket" in seen[-1]
+    assert "mxnet_steps_total" in seen[-1]
+    assert health["status"] == "ok" and health["pid"] == os.getpid()
+
+
+def test_exporter_404_and_idempotent_start():
+    from mxnet_tpu.telemetry.exporter import TelemetryServer
+    with TelemetryServer(port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+
+
+# -- StepLogger --------------------------------------------------------------
+
+def test_steplogger_jsonl_schema(tmp_path, monkeypatch):
+    log = tmp_path / "steps.jsonl"
+    monkeypatch.setenv("MXNET_TELEMETRY_LOG", str(log))
+    slog = telemetry.StepLogger("unit_phase", meta={"note": "x"})
+    slog.step(samples=32, loss=1.25, extra={"epoch": 0})
+    slog.step(samples=32, steps=4)
+    slog.close(final=True)
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert [r["event"] for r in recs] == ["run_start", "step", "step",
+                                         "run_end"]
+    assert recs[0]["phase"] == "unit_phase" and recs[0]["note"] == "x"
+    step = recs[1]
+    for key in ("wall_s", "samples", "loss", "amp_scale",
+                "amp_skipped_steps", "feed_overlap_frac", "ckpt_save_us",
+                "ckpt_wait_us", "ts"):
+        assert key in step, key
+    assert step["loss"] == 1.25 and step["epoch"] == 0
+    assert recs[2]["steps"] == 4
+    assert recs[3]["steps"] == 5 and recs[3]["samples"] == 64
+    assert recs[3]["final"] is True
+
+
+def test_steplogger_disabled_is_null(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    slog = telemetry.maybe_step_logger("off_phase")
+    before = telemetry.counter("mxnet_steps_total").value()
+    slog.step(samples=8)
+    slog.close()
+    assert telemetry.counter("mxnet_steps_total").value() == before
+
+
+def test_fit_bit_identical_telemetry_on_off(monkeypatch):
+    """MXNET_TELEMETRY=0 must not change the math: same init, same data,
+    identical trained params either way."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(160, 8)).astype(np.float32)
+    Y = rng.randint(0, 4, size=(160,)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="bfc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def run():
+        mx.random.seed(7)           # Xavier draws from the global RNG
+        train = mx.io.NDArrayIter(X, Y, batch_size=40)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.2},
+                initializer=mx.init.Xavier(rnd_type="gaussian",
+                                           factor_type="avg",
+                                           magnitude=2.0),
+                num_epoch=2)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    p_on = run()
+    monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    p_off = run()
+    assert set(p_on) == set(p_off)
+    for k in p_on:
+        assert np.array_equal(p_on[k], p_off[k]), k
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def test_watchdog_stall_dump_and_rearm(tmp_path):
+    from mxnet_tpu.telemetry import watchdog
+    dump = tmp_path / "stall.txt"
+    c = telemetry.counter("mxnet_watchdog_stall_dumps_total")
+    before = c.value()
+    watchdog.install(stall_s=0.3, path=str(dump))
+    try:
+        watchdog.beat("unit test")
+        deadline = time.monotonic() + 5.0
+        while c.value() == before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert c.value() == before + 1
+        text = dump.read_text()
+        assert "watchdog: step stalled" in text
+        assert "unit test" in text          # last-live label on record
+        assert "Thread" in text             # faulthandler stacks present
+        # one dump per stall: no second dump until a beat re-arms it
+        time.sleep(0.7)
+        assert c.value() == before + 1
+    finally:
+        watchdog.uninstall()
+
+
+def test_watchdog_disabled_when_unset(monkeypatch):
+    from mxnet_tpu.telemetry import watchdog
+    monkeypatch.delenv("MXNET_TELEMETRY_STALL_S", raising=False)
+    assert watchdog.install() is None
+
+
+def test_watchdog_sigusr1_dumps_and_process_survives():
+    # regression: faulthandler.register(chain=True) with no prior handler
+    # chains to SIG_DFL, whose disposition for SIGUSR1 is TERMINATE — the
+    # on-demand dump must absorb the signal, not kill the process.
+    # Subprocess: faulthandler latches the stderr fd at register time, so
+    # in-process capture fixtures can't observe the dump reliably.
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import os, signal, time\n"
+         "from mxnet_tpu.telemetry import watchdog\n"
+         "assert watchdog.install_sigusr1()\n"
+         "os.kill(os.getpid(), signal.SIGUSR1)\n"
+         "time.sleep(0.5)\n"
+         "print('SURVIVED-SIGUSR1')\n"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-400:])
+    assert "SURVIVED-SIGUSR1" in proc.stdout, proc.stdout
+    assert "Current thread" in proc.stderr or "Thread" in proc.stderr, \
+        proc.stderr[:400]
+
+
+# -- serving native series ---------------------------------------------------
+
+def test_serving_metrics_native_gauge_and_histogram():
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    sm = ServingMetrics()
+    try:
+        mname = sm.name.replace("#", "_")
+        sm.record_queue_depth(7)
+        sm.record_done(0.004)
+        sm.record_done(2.0)
+        g = telemetry.get_registry().get(f"mxnet_{mname}_queue_depth")
+        h = telemetry.get_registry().get(
+            f"mxnet_{mname}_request_latency_seconds")
+        assert g.value() == 7
+        assert h.snapshot()["count"] == 2
+        text = telemetry.get_registry().render_prometheus()
+        # the absorbed snapshot also carries queue_depth — dedup keeps
+        # exactly one sample line and the native gauge wins
+        samples = [ln for ln in text.splitlines()
+                   if ln.startswith(f"mxnet_{mname}_queue_depth ")]
+        assert samples == [f"mxnet_{mname}_queue_depth 7"]
+    finally:
+        sm.close()
+
+
+def test_pipeline_stats_feeds_active():
+    from mxnet_tpu import pipeline
+    s = pipeline.stats()
+    assert s["feeds_active"] == s["feeds_opened"] - s["feeds_closed"]

@@ -38,7 +38,10 @@ case "$stage" in
       python -m mxnet_tpu.amp --selftest
     echo "== checkpoint smoke (crash injection: SIGKILL mid-commit, resume)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-      python -m mxnet_tpu.checkpoint --selftest ;;
+      python -m mxnet_tpu.checkpoint --selftest
+    echo "== telemetry smoke (registry/scrape/JSONL/overhead/watchdog)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.telemetry --selftest ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)
