@@ -951,6 +951,81 @@ def _checkpoint_lane():
     return out
 
 
+def _elastic_ckpt_lane():
+    """Topology-elastic restore (ISSUE 8): save the checkpoint lane's
+    MLP state sharded as if 8 devices owned it (num_shards=8), then
+    restore and reshard onto the CURRENT (smaller) mesh — the
+    preemption-then-shrink path. Reports save/restore wall time, the
+    bytes reassembled+resharded, and proves the roundtrip is bitwise
+    lossless (state_sha256 before == after)."""
+    import shutil
+    import tempfile
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import DataParallelTrainer, data_parallel_mesh
+    from mxnet_tpu.checkpoint import (CheckpointManager, TrainingState,
+                                      state_sha256)
+
+    save_shards, restore_devices = 8, min(4, len(jax.devices()))
+    mesh = data_parallel_mesh(restore_devices,
+                              jax.devices()[:restore_devices])
+    batch, dim, hidden = 256, 1024, 512
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="elfc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="elfc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, dim)).astype(np.float32)
+    y = rng.randint(0, 64, (batch,)).astype(np.float32)
+    tr = DataParallelTrainer(sym, mesh, optimizer="sgd",
+                             learning_rate=0.05, momentum=0.9,
+                             rescale_grad=1.0 / batch, dtype="float32")
+    params, states, aux = tr.init_state(
+        {"data": (batch, dim), "softmax_label": (batch,)})
+    inputs = tr.shard_inputs([x, y])
+    for _ in range(4):
+        params, states, aux, loss, _ = tr.step(params, states, aux,
+                                               inputs)
+    float(loss)
+    arrays, tmeta = tr.export_training_state(params, states, aux)
+    st = TrainingState(arrays=arrays, meta={
+        "kind": "bench", "epoch": 0, "batch": 4, "step": 4,
+        "trainer": tmeta})
+    sha_before = state_sha256(st)
+    root = tempfile.mkdtemp(prefix="bench_elastic_ckpt_")
+    try:
+        mgr = CheckpointManager(os.path.join(root, "ckpt"),
+                                async_save=False, keep_last_n=0,
+                                num_shards=save_shards)
+        t0 = time.perf_counter()
+        mgr.save(st, step=4)
+        save_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        back = mgr.restore()
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        reshard_bytes = sum(
+            np.asarray(v).nbytes for v in back.arrays.values())
+        # reshard onto the current mesh: device_put in import is the
+        # elastic step — the saved shard layout never constrains it
+        t0 = time.perf_counter()
+        tr.import_training_state(back.arrays, back.meta["trainer"])
+        reshard_ms = (time.perf_counter() - t0) * 1e3
+        out = {
+            "saved_shards": save_shards,
+            "restore_devices": restore_devices,
+            "save_ms": round(save_ms, 1),
+            "restore_ms": round(restore_ms, 1),
+            "reshard_ms": round(reshard_ms, 1),
+            "reshard_bytes": int(reshard_bytes),
+            "bit_identical": state_sha256(back) == sha_before,
+        }
+        mgr.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _telemetry_lane():
     """Step-telemetry overhead A/B (mxnet_tpu.telemetry, ISSUE 6): the
     checkpoint lane's MLP stepped with NO recorder vs with a live
@@ -1227,6 +1302,15 @@ def main(argv=None):
     except Exception as e:
         ckpt_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("checkpoint", ckpt_lane)
+    # topology-elastic restore: 8-shard save resharded onto the current
+    # mesh, bitwise-lossless (ISSUE 8)
+    try:
+        elastic_lane = _gated("elastic_ckpt", 60, _elastic_ckpt_lane)
+    except _BudgetExceeded:
+        elastic_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        elastic_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("elastic_ckpt", elastic_lane)
     # step-telemetry overhead A/B + /metrics scrape latency (ISSUE 6)
     try:
         tele_lane = _gated("telemetry", 60, _telemetry_lane)
@@ -1333,6 +1417,12 @@ def main(argv=None):
         "checkpoint_restore_ms": ckpt_lane.get("restore_ms"),
         "checkpoint_bytes_per_commit": ckpt_lane.get(
             "ckpt_bytes_per_commit"),
+        # elastic checkpointing (ISSUE 8): 8-shard save restored +
+        # resharded onto the current mesh, bitwise lossless
+        "elastic_ckpt_restore_ms": elastic_lane.get(
+            "restore_ms", elastic_lane.get("status")),
+        "elastic_ckpt_reshard_bytes": elastic_lane.get("reshard_bytes"),
+        "elastic_ckpt_bit_identical": elastic_lane.get("bit_identical"),
         # step telemetry (ISSUE 6): recorder-on overhead vs bare loop +
         # /metrics scrape latency (full payload streamed above)
         "telemetry_overhead_pct": tele_lane.get(

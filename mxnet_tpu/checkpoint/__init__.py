@@ -7,6 +7,14 @@ states incl. fp32 masters + amp loss-scaler + RNG + epoch/batch
 cursor), asynchronous saves that overlap training, retention, and
 auto-resume — docs/CHECKPOINT.md.
 
+Topology-elastic: commits are SHARDED (per-shard checksum manifests +
+a TOPOLOGY.json seal written atomically last), and restore reassembles
+the logical arrays and reshards them onto the CURRENT mesh — a run
+checkpointed on 8 devices resumes on 4 (or 2 on 4), mid-epoch cursor
+rescaled to the new global batch layout. Transient shard I/O retries
+with backoff (MXNET_CHECKPOINT_RETRIES/_BACKOFF_S); a commit with
+missing/torn shards is skipped for the previous good step.
+
 User surface:
 
     mod.fit(it, num_epoch=20, checkpoint_dir="ckpt", resume=True)
@@ -22,7 +30,7 @@ User surface:
 """
 from .manager import CheckpointManager
 from .state import (TrainingState, capture_module_state,
-                    restore_module_state)
+                    restore_module_state, rescale_cursor, state_sha256)
 
 __all__ = ["CheckpointManager", "TrainingState", "capture_module_state",
-           "restore_module_state"]
+           "restore_module_state", "rescale_cursor", "state_sha256"]

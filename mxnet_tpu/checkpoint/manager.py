@@ -7,25 +7,49 @@ state (optimizer momenta, amp scaler, RNG, cursor) is simply lost. On
 preemptible TPU fleets that is the difference between "restart the
 epoch" and "restart the month" (Check-N-Run FAST'22, CheckFreq FAST'21).
 
-Commit protocol (crash-consistent at every instant):
+Commit protocol (crash-consistent at every instant; format 2 = elastic
+sharded layout, docs/CHECKPOINT.md):
 
-    <dir>/.staging-step-XXXXXXXXXX.<pid>/    (1) write payload files,
-        arrays.nd  optimizer.bin                 fsync each
-        MANIFEST.json                        (2) write the manifest LAST
-                                                 (sha256 + size of every
-                                                 payload file), fsync
-    <dir>/step-XXXXXXXXXX/                   (3) os.replace(staging,
+    <dir>/.staging-step-XXXXXXXXXX.<pid>/
+        shard-00000-of-0000N/                (1) per shard: write payload
+            arrays.nd  [optimizer.bin]           files, fsync each
+            MANIFEST.json                    (2) write the shard manifest
+        shard-00001-of-0000N/ ...                LAST (sha256 + size of
+                                                 every payload), fsync
+        TOPOLOGY.json                        (3) write the step's global
+                                                 seal LAST: topology
+                                                 (device/process count,
+                                                 mesh axes), the full
+                                                 shard set with each
+                                                 manifest's sha256, and
+                                                 the array->shard map
+    <dir>/step-XXXXXXXXXX/                   (4) os.replace(staging,
                                                  final) — atomic dir
                                                  rename — then fsync the
                                                  parent dir
-    old steps                                (4) retention (keep-last-N
-                                                 + best-k-by-metric)
+    old steps                                (5) retention (keep-last-N
+                                                 + best-k-by-metric —
+                                                 counted per COMMIT, not
+                                                 per shard file)
 
-`kill -9` before (3) leaves only a `.staging-*` dir (ignored and swept
-on the next run); after (3) the new step is durable. Restore scans
-`step-*` newest-first and takes the first dir whose MANIFEST checksums
-validate, so even a torn rename target or bit-rotted payload falls back
-to the previous committed step instead of failing the job.
+`kill -9` before (4) leaves only a `.staging-*` dir (ignored and swept
+on the next run); after (4) the new step is durable. Restore scans
+`step-*` newest-first and takes the first dir whose TOPOLOGY shard set
+is COMPLETE and whose per-shard checksums all validate, so a torn
+rename target, a deleted shard file or a bit-rotted payload falls back
+to the previous committed step instead of failing the job
+(`ckpt_fallback_total` counts the skips). Elasticity: shard files are
+host-side splits (axis 0 when divisible, else whole arrays), so restore
+reassembles the logical arrays and the CONSUMER's device_put reshards
+them onto whatever mesh the current process runs — a checkpoint taken
+on 8 devices resumes on 4 (or 2 on 4) without conversion. Format-1
+dirs (single MANIFEST.json, PR 5) stay readable.
+
+Transient shard I/O (flaky NFS/GCS fuse mounts mid-preemption) is
+retried with exponential backoff: `MXNET_CHECKPOINT_RETRIES` attempts
+(default 2) starting at `MXNET_CHECKPOINT_BACKOFF_S` seconds (default
+0.5); `ckpt_retry_total` counts them. The saver thread beats the
+telemetry watchdog per shard so a long commit is visibly alive.
 
 Async saves: jax arrays are immutable, so the training thread's capture
 is a set of buffer references (state.py); the saver thread does the
@@ -35,12 +59,12 @@ saver exceptions re-raised on the training thread, idempotent close).
 `ckpt_save_us` / `ckpt_wait_us` / `ckpt_overlap_frac` / `ckpt_bytes`
 are exported via `profiler.register_counter_export("checkpoint")`.
 
-Distributed jobs: rank 0 writes (default) or every rank writes its own
-`step-N.r<rank>` shard dir (`sharded=True`); either way commit ends in
-a `dist.barrier`, so no rank proceeds believing a step is durable that
-another rank has not finished. Multi-process saves run blocking — a
-collective barrier may not race training collectives from a side
-thread.
+Distributed jobs: rank 0 writes everything (default) or every rank
+writes the shards it owns into one shared staging dir and rank 0 seals
+the step (`sharded=True`); either way commit ends in a `dist.barrier`,
+so no rank proceeds believing a step is durable that another rank has
+not finished. Multi-process saves run blocking — a collective barrier
+may not race training collectives from a side thread.
 
 Crash injection (the `--selftest` contract) is built in: setting
 `MXNET_CHECKPOINT_INJECT_CRASH=<point>@<step>` with point one of
@@ -64,7 +88,9 @@ from .state import TrainingState
 _STEP_PREFIX = "step-"
 _STAGING_PREFIX = ".staging-"
 _MANIFEST = "MANIFEST.json"
-_FORMAT = 1
+_TOPOLOGY = "TOPOLOGY.json"
+_SHARD_PREFIX = "shard-"
+_FORMAT = 2
 
 
 def _crash_requested(point, step):
@@ -118,13 +144,18 @@ class CheckpointManager:
     async_save : overlap serialization/write with training on a saver
         thread (default `MXNET_CHECKPOINT_ASYNC`, on; forced off for
         multi-process jobs — the commit barrier is a collective)
-    sharded : multi-process jobs write per-rank `step-N.r<rank>` dirs
-        instead of rank-0-only
+    num_shards : shard count of the elastic layout (default
+        `MXNET_CHECKPOINT_SHARDS`; <=0 = auto = the device count the
+        executor mesh spans, so each device slot owns one shard)
+    sharded : multi-process jobs — every rank writes the shards it owns
+        (k % process_count == rank) into a shared staging dir and rank 0
+        seals the step with TOPOLOGY.json, instead of rank-0-only full
+        writes
     """
 
     def __init__(self, directory, keep_last_n=None, keep_best_k=None,
-                 best_mode="max", async_save=None, sharded=False,
-                 logger=None):
+                 best_mode="max", async_save=None, num_shards=None,
+                 sharded=False, logger=None):
         from .. import config
         self.directory = os.path.abspath(os.fspath(directory))
         self.keep_last_n = int(config.get("MXNET_CHECKPOINT_KEEP")
@@ -137,6 +168,19 @@ class CheckpointManager:
         self.sharded = bool(sharded)
         self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
         self._rank, self._nranks = _rank_info()
+        n = int(config.get("MXNET_CHECKPOINT_SHARDS")
+                if num_shards is None else num_shards)
+        if n <= 0:
+            try:
+                import jax
+                n = max(1, jax.device_count())
+            except Exception:
+                n = 1
+        self.num_shards = n
+        self._retries = max(0, int(config.get("MXNET_CHECKPOINT_RETRIES")))
+        self._backoff_s = float(config.get("MXNET_CHECKPOINT_BACKOFF_S"))
+        self._inject_io = int(os.environ.get(
+            "MXNET_CHECKPOINT_INJECT_IO_FAIL", "0") or 0)
         want_async = bool(config.get("MXNET_CHECKPOINT_ASYNC")) \
             if async_save is None else bool(async_save)
         if want_async and self._nranks > 1:
@@ -155,7 +199,8 @@ class CheckpointManager:
         self._counters = {"ckpt_commits": 0, "ckpt_failures": 0,
                           "ckpt_bytes": 0, "ckpt_save_us": 0,
                           "ckpt_wait_us": 0, "ckpt_last_step": -1,
-                          "ckpt_retained": 0}
+                          "ckpt_retained": 0, "ckpt_retry_total": 0,
+                          "ckpt_fallback_total": 0}
         self._preempted = threading.Event()
         self._prev_sigterm = None
 
@@ -170,26 +215,18 @@ class CheckpointManager:
         return self.sharded or self._rank == 0
 
     def _step_dirname(self, step):
-        base = f"{_STEP_PREFIX}{int(step):010d}"
-        if self.sharded and self._rank > 0:
-            base += f".r{self._rank}"
-        return base
+        return f"{_STEP_PREFIX}{int(step):010d}"
+
+    def _shard_dirname(self, k):
+        return f"{_SHARD_PREFIX}{int(k):05d}-of-{self.num_shards:05d}"
 
     def _parse_step(self, name):
-        """step int for a committed dir THIS process should read, else
-        None (other ranks' shards are invisible here)."""
+        """step int for a committed dir, else None. Pre-elastic per-rank
+        `step-N.r<rank>` dirs are partial states — skipped."""
         if not name.startswith(_STEP_PREFIX):
             return None
         body = name[len(_STEP_PREFIX):]
-        rank = 0
         if ".r" in body:
-            body, _, r = body.partition(".r")
-            try:
-                rank = int(r)
-            except ValueError:
-                return None
-        want = self._rank if self.sharded else 0
-        if rank != want:
             return None
         try:
             return int(body)
@@ -273,8 +310,11 @@ class CheckpointManager:
         out = []
         for name in entries:
             s = self._parse_step(name)
-            if s is not None and os.path.isfile(
-                    os.path.join(self.directory, name, _MANIFEST)):
+            if s is not None and (
+                    os.path.isfile(os.path.join(self.directory, name,
+                                                _TOPOLOGY))
+                    or os.path.isfile(os.path.join(self.directory, name,
+                                                   _MANIFEST))):
                 out.append(s)
         return sorted(out)
 
@@ -284,9 +324,13 @@ class CheckpointManager:
 
     def restore(self, step=None):
         """Load the newest committed checkpoint (or exactly `step`),
-        VALIDATING manifest checksums — a corrupt/torn dir is skipped
-        (warned) and the next-newest valid one is returned. None when
-        nothing restorable exists."""
+        VALIDATING shard-set completeness against TOPOLOGY.json and every
+        per-shard manifest checksum — a commit with a missing, torn or
+        bit-rotted shard is skipped (warned, `ckpt_fallback_total`) and
+        the next-newest valid one is returned. None when nothing
+        restorable exists. Arrays come back as reassembled host numpy:
+        feeding them to init_params / import_training_state reshards
+        them onto the CURRENT mesh, whatever its size."""
         self.wait()
         candidates = self.steps()
         if step is not None:
@@ -296,6 +340,8 @@ class CheckpointManager:
             st = self._load_validated(path)
             if st is not None:
                 return st
+            with self._cond:
+                self._counters["ckpt_fallback_total"] += 1
             self.logger.warning(
                 "checkpoint: %s failed validation; falling back to the "
                 "previous committed step", path)
@@ -393,21 +439,80 @@ class CheckpointManager:
                     self._job = None
                     self._cond.notify_all()
 
+    # -- shard I/O (retry + liveness + fault injection) ----------------------
+
+    def _beat(self, label):
+        """Saver-thread liveness tick for the telemetry stall watchdog:
+        a long multi-shard commit must read as alive, not as a hung
+        training step."""
+        try:
+            from ..telemetry import watchdog
+            watchdog.beat(label)
+        except Exception:                       # pragma: no cover
+            pass
+
+    def _with_retries(self, fn, what):
+        """Run one shard I/O operation, retrying transient OSErrors
+        MXNET_CHECKPOINT_RETRIES times with exponential backoff from
+        MXNET_CHECKPOINT_BACKOFF_S. Retries tick `ckpt_retry_total`."""
+        for i in range(self._retries + 1):
+            try:
+                return fn()
+            except OSError as e:
+                if i >= self._retries:
+                    raise
+                with self._cond:
+                    self._counters["ckpt_retry_total"] += 1
+                delay = self._backoff_s * (2 ** i)
+                self.logger.warning(
+                    "checkpoint: transient I/O failure (%s: %s) — retry "
+                    "%d/%d in %.2fs", what, e, i + 1, self._retries,
+                    delay)
+                time.sleep(delay)
+
+    def _write_file(self, path, payload):
+        def _write():
+            if self._inject_io > 0:     # selftest/CI fault injection
+                self._inject_io -= 1
+                raise OSError(f"injected I/O failure "
+                              f"(MXNET_CHECKPOINT_INJECT_IO_FAIL): {path}")
+            with open(path, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+        self._with_retries(_write, f"write {os.path.basename(path)}")
+
+    def _read_file(self, path):
+        def _read():
+            with open(path, "rb") as f:
+                return f.read()
+        return self._with_retries(_read, f"read {os.path.basename(path)}")
+
     # -- commit protocol -----------------------------------------------------
 
-    def _commit(self, state, step, metric):
-        t0 = time.perf_counter()
-        final = os.path.join(self.directory, self._step_dirname(step))
-        staging = os.path.join(
-            self.directory,
-            f"{_STAGING_PREFIX}{os.path.basename(final)}.{os.getpid()}")
-        if os.path.isdir(staging):
-            shutil.rmtree(staging)
-        os.makedirs(staging)
-        files = {}
+    def _current_topology(self, state):
+        from ..parallel.mesh import current_topology
+        try:
+            topo = current_topology()
+        except Exception:
+            topo = {"device_count": 1, "process_count": self._nranks,
+                    "process_index": self._rank}
+        topo["num_shards"] = self.num_shards
+        mesh_axes = (state.meta.get("trainer") or {}).get("mesh")
+        if mesh_axes:
+            topo["mesh_axes"] = mesh_axes
+        return topo
+
+    def _write_shard(self, parent, k, files, step):
+        """Write one shard dir (payload files fsynced, shard MANIFEST
+        last). Returns (dirname, manifest_sha256, payload_bytes)."""
+        sname = self._shard_dirname(k)
+        sdir = os.path.join(parent, sname)
+        os.makedirs(sdir, exist_ok=True)
+        manifest_files = {}
         nbytes = 0
-        for fname, payload in state.to_files():
-            path = os.path.join(staging, fname)
+        for fname, payload in files:
+            path = os.path.join(sdir, fname)
             if _crash_requested("mid-arrays", step) \
                     and fname.startswith("arrays"):
                 with open(path, "wb") as f:      # torn payload, then die
@@ -415,30 +520,108 @@ class CheckpointManager:
                     f.flush()
                     os.fsync(f.fileno())
                 os.kill(os.getpid(), signal.SIGKILL)
-            with open(path, "wb") as f:
-                f.write(payload)
-                f.flush()
-                os.fsync(f.fileno())
-            files[fname] = {"sha256": hashlib.sha256(payload).hexdigest(),
-                            "bytes": len(payload)}
+            self._write_file(path, payload)
+            manifest_files[fname] = {
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "bytes": len(payload)}
             nbytes += len(payload)
-        manifest = {"format": _FORMAT, "step": int(step),
-                    "metric": None if metric is None else float(metric),
-                    "wall_time": time.time(),
-                    "meta": state.meta, "files": files}
-        payload = json.dumps(manifest, indent=1).encode("utf-8")
-        mpath = os.path.join(staging, _MANIFEST)
-        with open(mpath, "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
+        mpayload = json.dumps(
+            {"format": _FORMAT, "shard": int(k),
+             "num_shards": self.num_shards, "files": manifest_files},
+            indent=1).encode("utf-8")
+        self._write_file(os.path.join(sdir, _MANIFEST), mpayload)
+        self._beat(f"checkpoint_saver step {step} shard {k}")
+        return sname, hashlib.sha256(mpayload).hexdigest(), nbytes
+
+    def _seal_step(self, staging, state, step, metric, shards, shard_map):
+        """TOPOLOGY.json LAST — the step's global commit record."""
+        topo = {"format": _FORMAT, "step": int(step),
+                "metric": None if metric is None else float(metric),
+                "wall_time": time.time(), "meta": state.meta,
+                "topology": self._current_topology(state),
+                "shards": shards, "shard_map": shard_map}
+        self._write_file(os.path.join(staging, _TOPOLOGY),
+                         json.dumps(topo, indent=1).encode("utf-8"))
+
+    def _commit(self, state, step, metric):
+        if self._nranks > 1 and self.sharded:
+            return self._commit_cooperative(state, step, metric)
+        t0 = time.perf_counter()
+        self._beat(f"checkpoint_saver step {step}")
+        final = os.path.join(self.directory, self._step_dirname(step))
+        staging = os.path.join(
+            self.directory,
+            f"{_STAGING_PREFIX}{os.path.basename(final)}.{os.getpid()}")
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        shard_files, shard_map = state.to_shard_files(self.num_shards)
+        shards = {}
+        nbytes = 0
+        for k, files in enumerate(shard_files):
+            sname, msha, n = self._write_shard(staging, k, files, step)
+            shards[sname] = {"manifest_sha256": msha}
+            nbytes += n
+        self._seal_step(staging, state, step, metric, shards, shard_map)
         _maybe_crash("pre-rename", step)
         if os.path.isdir(final):               # re-save of the same step
             shutil.rmtree(final)
         os.replace(staging, final)
         _fsync_dir(self.directory)
         _maybe_crash("post-rename", step)
-        save_s = time.perf_counter() - t0
+        self._finish_commit(step, nbytes, time.perf_counter() - t0)
+
+    def _commit_cooperative(self, state, step, metric):
+        """Multi-process sharded commit: every rank writes the shards it
+        owns (k % process_count == rank) into ONE shared staging dir;
+        after the all-shards barrier, rank 0 seals the step with
+        TOPOLOGY.json and the atomic rename. A kill at any instant
+        leaves either the old newest step (seal missing -> restore falls
+        back) or the complete new one."""
+        from .. import dist
+        t0 = time.perf_counter()
+        final = os.path.join(self.directory, self._step_dirname(step))
+        staging = os.path.join(
+            self.directory,
+            f"{_STAGING_PREFIX}{os.path.basename(final)}.shared")
+        if self._rank == 0:
+            shutil.rmtree(staging, ignore_errors=True)
+            os.makedirs(staging, exist_ok=True)
+        dist.barrier(f"ckpt_stage_{step}")
+        shard_files, shard_map = state.to_shard_files(self.num_shards)
+        shards = {}
+        nbytes = 0
+        for k, files in enumerate(shard_files):
+            if k % self._nranks != self._rank:
+                continue
+            sname, msha, n = self._write_shard(staging, k, files, step)
+            shards[sname] = {"manifest_sha256": msha}
+            nbytes += n
+        dist.barrier(f"ckpt_shards_{step}")
+        if self._rank == 0:
+            # other ranks' manifest checksums are re-derived from disk —
+            # the shared filesystem is the only channel the ranks share
+            for k in range(len(shard_files)):
+                sname = self._shard_dirname(k)
+                if sname in shards:
+                    continue
+                mpayload = self._read_file(
+                    os.path.join(staging, sname, _MANIFEST))
+                shards[sname] = {
+                    "manifest_sha256":
+                        hashlib.sha256(mpayload).hexdigest()}
+            self._seal_step(staging, state, step, metric, shards,
+                            shard_map)
+            _maybe_crash("pre-rename", step)
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.replace(staging, final)
+            _fsync_dir(self.directory)
+            _maybe_crash("post-rename", step)
+        dist.barrier(f"ckpt_seal_{step}")
+        self._finish_commit(step, nbytes, time.perf_counter() - t0)
+
+    def _finish_commit(self, step, nbytes, save_s):
         with self._cond:
             self._counters["ckpt_commits"] += 1
             self._counters["ckpt_bytes"] += nbytes
@@ -456,34 +639,77 @@ class CheckpointManager:
             pass
         self._apply_retention()
 
+    # -- load/validate -------------------------------------------------------
+
     def _load_validated(self, path):
         try:
-            with open(os.path.join(path, _MANIFEST), "rb") as f:
-                manifest = json.loads(f.read().decode("utf-8"))
-            blobs = {}
-            for fname, info in manifest["files"].items():
-                with open(os.path.join(path, fname), "rb") as f:
-                    payload = f.read()
-                if len(payload) != int(info["bytes"]) or \
-                        hashlib.sha256(payload).hexdigest() != \
-                        info["sha256"]:
-                    raise ValueError(f"{fname}: checksum mismatch")
-                blobs[fname] = payload
-            return TrainingState.from_files(blobs, manifest)
+            if os.path.isfile(os.path.join(path, _TOPOLOGY)):
+                return self._load_sharded(path)
+            return self._load_format1(path)
         except Exception as e:
             self.logger.warning("checkpoint: cannot load %s (%s)", path, e)
             return None
 
+    def _load_sharded(self, path):
+        """Elastic (format 2) loader: the shard SET must be complete
+        against TOPOLOGY.json — an absent shard dir/file is a hard
+        validation failure (caller falls back a step), never a raw
+        FileNotFoundError at array-load time."""
+        topo = json.loads(
+            self._read_file(os.path.join(path, _TOPOLOGY)).decode("utf-8"))
+        shard_blobs = []
+        for sname in sorted(topo.get("shards") or {}):
+            sdir = os.path.join(path, sname)
+            mpath = os.path.join(sdir, _MANIFEST)
+            if not os.path.isfile(mpath):
+                raise ValueError(f"{sname}: shard manifest absent")
+            mpayload = self._read_file(mpath)
+            want = topo["shards"][sname].get("manifest_sha256")
+            if want and hashlib.sha256(mpayload).hexdigest() != want:
+                raise ValueError(f"{sname}: manifest checksum mismatch")
+            manifest = json.loads(mpayload.decode("utf-8"))
+            blobs = {}
+            for fname, info in manifest["files"].items():
+                fpath = os.path.join(sdir, fname)
+                if not os.path.isfile(fpath):
+                    raise ValueError(f"{sname}/{fname}: shard file absent")
+                payload = self._read_file(fpath)
+                if len(payload) != int(info["bytes"]) or \
+                        hashlib.sha256(payload).hexdigest() != \
+                        info["sha256"]:
+                    raise ValueError(f"{sname}/{fname}: checksum mismatch")
+                blobs[fname] = payload
+            shard_blobs.append(blobs)
+        st = TrainingState.from_shard_blobs(shard_blobs, topo)
+        st.meta.setdefault("topology", topo.get("topology") or {})
+        return st
+
+    def _load_format1(self, path):
+        """PR 5 single-manifest layout — still readable, forward-only."""
+        with open(os.path.join(path, _MANIFEST), "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+        blobs = {}
+        for fname, info in manifest["files"].items():
+            payload = self._read_file(os.path.join(path, fname))
+            if len(payload) != int(info["bytes"]) or \
+                    hashlib.sha256(payload).hexdigest() != \
+                    info["sha256"]:
+                raise ValueError(f"{fname}: checksum mismatch")
+            blobs[fname] = payload
+        return TrainingState.from_files(blobs, manifest)
+
     # -- retention -----------------------------------------------------------
 
     def _read_metric(self, step):
-        path = os.path.join(self.directory, self._step_dirname(step),
-                            _MANIFEST)
-        try:
-            with open(path, "rb") as f:
-                return json.loads(f.read().decode("utf-8")).get("metric")
-        except Exception:
-            return None
+        d = os.path.join(self.directory, self._step_dirname(step))
+        for fname in (_TOPOLOGY, _MANIFEST):
+            try:
+                with open(os.path.join(d, fname), "rb") as f:
+                    return json.loads(
+                        f.read().decode("utf-8")).get("metric")
+            except Exception:
+                continue
+        return None
 
     def _apply_retention(self):
         steps = self.steps()

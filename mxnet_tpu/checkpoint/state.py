@@ -40,6 +40,30 @@ _PARAM = "param:"
 _AUX = "aux:"
 
 
+def _encode_arrays(host):
+    """(fname, bytes) for a {name: numpy} dict — the reference container
+    when every dtype has a type flag, else a plain pickle (bfloat16
+    et al.). Each shard makes this choice independently."""
+    from ..ndarray.container import container_bytes, _DTYPE_TO_FLAG
+    if all(a.dtype in _DTYPE_TO_FLAG for a in host.values()):
+        return "arrays.nd", container_bytes(host)
+    return "arrays.pkl", pickle.dumps(host)
+
+
+def _decode_arrays(fname, payload):
+    """Inverse of _encode_arrays for one validated payload."""
+    if fname == "arrays.nd":
+        from ..ndarray.container import load_container_bytes
+        items, names = load_container_bytes(payload, name=fname)
+        out = {}
+        for name, item in zip(names, items):
+            if item[0] != "dense":
+                raise ValueError(f"checkpoint: non-dense array {name!r}")
+            out[name] = item[1]
+        return out
+    return pickle.loads(payload)
+
+
 def _clone_tree(obj):
     """Structure-copy a state tree, re-wrapping NDArrays around their
     CURRENT immutable device buffer: later in-place updates rebind the
@@ -99,31 +123,99 @@ class TrainingState:
         reference container when every dtype has a type flag; otherwise
         (bfloat16 et al.) a plain pickle of {name: numpy}."""
         host = {k: _host(v) for k, v in self.arrays.items()}
-        from ..ndarray.container import container_bytes, _DTYPE_TO_FLAG
-        if all(a.dtype in _DTYPE_TO_FLAG for a in host.values()):
-            files = [("arrays.nd", container_bytes(host))]
-        else:
-            files = [("arrays.pkl", pickle.dumps(host))]
+        files = [_encode_arrays(host)]
         ob = self.optimizer_bytes()
         if ob is not None:
             files.append(("optimizer.bin", ob))
         return files
 
+    def to_shard_files(self, num_shards):
+        """Partition the snapshot into `num_shards` independent shard
+        file lists plus the array->shard placement map that goes into
+        TOPOLOGY.json.
+
+        Placement policy: arrays whose leading axis divides evenly are
+        split along axis 0 (mode "split0" — part k lives in shard k);
+        everything else (scalars, odd leading axes) is placed whole,
+        round-robin by sorted name (mode "whole"). The opaque optimizer
+        pickle always lands in shard 0. A shard can end up empty — its
+        manifest then just lists no payload files.
+
+        Returns (files_per_shard, shard_map) where files_per_shard[k] is
+        the [(fname, bytes)] write list of shard k.
+        """
+        num_shards = max(1, int(num_shards))
+        host = {k: _host(v) for k, v in self.arrays.items()}
+        shard_arrays = [dict() for _ in range(num_shards)]
+        shard_map = {}
+        rr = 0
+        for name in sorted(host):
+            a = host[name]
+            if num_shards > 1 and a.ndim >= 1 \
+                    and a.shape[0] >= num_shards \
+                    and a.shape[0] % num_shards == 0:
+                for k, part in enumerate(
+                        _np.split(a, num_shards, axis=0)):
+                    shard_arrays[k][name] = part
+                shard_map[name] = {"mode": "split0"}
+            else:
+                k = rr % num_shards
+                rr += 1
+                shard_arrays[k][name] = a
+                shard_map[name] = {"mode": "whole", "shard": k}
+        files = []
+        for k in range(num_shards):
+            fs = []
+            if shard_arrays[k]:
+                fs.append(_encode_arrays(shard_arrays[k]))
+            if k == 0:
+                ob = self.optimizer_bytes()
+                if ob is not None:
+                    fs.append(("optimizer.bin", ob))
+            files.append(fs)
+        return files, shard_map
+
+    @classmethod
+    def from_shard_blobs(cls, shard_blobs, topology):
+        """Reassemble the logical snapshot from validated per-shard blobs
+        (manager._load_sharded). `shard_blobs` is a list in shard order of
+        {fname: bytes}; `topology` is the decoded TOPOLOGY.json. Split
+        arrays are concatenated back along axis 0; the result is host
+        numpy, so the consumer's device_put reshards it onto whatever
+        mesh the CURRENT process runs — elasticity lives here."""
+        shard_map = topology.get("shard_map") or {}
+        per_shard = []
+        for blobs in shard_blobs:
+            decoded = {}
+            for fname in ("arrays.nd", "arrays.pkl"):
+                if fname in blobs:
+                    decoded = _decode_arrays(fname, blobs[fname])
+            per_shard.append(decoded)
+        arrays = {}
+        for name, place in shard_map.items():
+            if place.get("mode") == "split0":
+                parts = [s[name] for s in per_shard if name in s]
+                if len(parts) != len(per_shard):
+                    raise ValueError(
+                        f"checkpoint: split array {name!r} has "
+                        f"{len(parts)}/{len(per_shard)} parts")
+                arrays[name] = _np.concatenate(parts, axis=0)
+            else:
+                arrays[name] = per_shard[int(place["shard"])][name]
+        st = cls(arrays=arrays, meta=topology.get("meta") or {},
+                 opt_bytes=shard_blobs[0].get("optimizer.bin")
+                 if shard_blobs else None)
+        st.step = int(topology.get("step", st.meta.get("step", 0) or 0))
+        st.metric = topology.get("metric")
+        return st
+
     @classmethod
     def from_files(cls, blobs, manifest):
         """Rebuild from validated {fname: bytes} + MANIFEST dict."""
         arrays = {}
-        if "arrays.nd" in blobs:
-            from ..ndarray.container import load_container_bytes
-            items, names = load_container_bytes(blobs["arrays.nd"],
-                                                name="arrays.nd")
-            for name, item in zip(names, items):
-                if item[0] != "dense":
-                    raise ValueError(
-                        f"checkpoint: non-dense array {name!r}")
-                arrays[name] = item[1]
-        elif "arrays.pkl" in blobs:
-            arrays = pickle.loads(blobs["arrays.pkl"])
+        for fname in ("arrays.nd", "arrays.pkl"):
+            if fname in blobs:
+                arrays = _decode_arrays(fname, blobs[fname])
         st = cls(arrays=arrays, meta=manifest.get("meta") or {},
                  opt_bytes=blobs.get("optimizer.bin"))
         st.step = int(manifest.get("step", st.meta.get("step", 0) or 0))
@@ -144,6 +236,46 @@ class TrainingState:
         return self._nd_dict(_AUX)
 
 
+def state_sha256(state):
+    """Topology-independent content hash of a snapshot: every array
+    (sorted by name; dtype, shape and raw bytes), the optimizer-state
+    pickle, and the fused-trainer scalars (t, loss-scaler). Two
+    snapshots of the same logical training state hash equal no matter
+    how many shards — or devices — they were saved and restored through;
+    the elastic selftest's bitwise-identity proof is this hash."""
+    import hashlib
+    h = hashlib.sha256()
+    for name in sorted(state.arrays):
+        a = _np.ascontiguousarray(_host(state.arrays[name]))
+        h.update(name.encode("utf-8"))
+        h.update(str(a.dtype).encode("utf-8"))
+        h.update(repr(tuple(a.shape)).encode("utf-8"))
+        h.update(a.tobytes())
+    ob = state.optimizer_bytes()
+    if ob is not None:
+        h.update(ob)
+    tmeta = state.meta.get("trainer") or {}
+    for k in ("t", "loss_scaler"):
+        if tmeta.get(k) is not None:
+            h.update(repr(tmeta[k]).encode("utf-8"))
+    return h.hexdigest()
+
+
+def rescale_cursor(meta, new_batch_size):
+    """Map a saved mid-epoch batch cursor onto the CURRENT global batch
+    layout. A topology change usually changes the global batch size; the
+    resumed run must skip the same number of SAMPLES, not the same
+    number of batches. Rounds down, so a non-divisible boundary replays
+    at most one partial batch rather than skipping unseen data. Equal
+    (or unrecorded) batch sizes return the cursor unchanged — the
+    bit-identical same-topology path."""
+    batch = int(meta.get("batch", 0) or 0)
+    old = meta.get("batch_size")
+    if not old or not new_batch_size or int(old) == int(new_batch_size):
+        return batch
+    return (batch * int(old)) // int(new_batch_size)
+
+
 # ---------------------------------------------------------------------------
 # Module (per-batch fit path) capture/restore
 # ---------------------------------------------------------------------------
@@ -158,7 +290,7 @@ def _updater_of(module):
     return getattr(module, "_updater", None)
 
 
-def capture_module_state(module, epoch, batch=0, step=0):
+def capture_module_state(module, epoch, batch=0, step=0, batch_size=None):
     """Snapshot a bound+initialized Module mid-fit. `epoch`/`batch` are
     the CURSOR TO RESUME AT (first epoch/batch the restored run should
     execute), not the last completed one. Cheap on the caller thread:
@@ -183,6 +315,8 @@ def capture_module_state(module, epoch, batch=0, step=0):
         "rng": _random.get_state(),
         "amp_dtype": _amp.get_dtype() if _amp.is_enabled() else None,
     }
+    if batch_size is not None:
+        meta["batch_size"] = int(batch_size)
     return TrainingState(arrays=arrays, opt_states=opt_states,
                          optimizer_pickle=opt_pickle, meta=meta)
 

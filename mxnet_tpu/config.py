@@ -81,9 +81,18 @@ _DOCUMENTED = {
     # thread; MXNET_CHECKPOINT_KEEP is the keep-last-N retention
     # default (<=0 keeps everything); MXNET_CHECKPOINT_BEST_K
     # additionally retains the best k steps by the save metric
+    # elastic sharding (PR: topology-elastic checkpoints):
+    # MXNET_CHECKPOINT_SHARDS=<n> fixes the shard count of the sharded
+    # layout (<=0 = auto = the device count the executor mesh spans);
+    # MXNET_CHECKPOINT_RETRIES / MXNET_CHECKPOINT_BACKOFF_S (float
+    # seconds, exponential) bound the retry loop around transient shard
+    # I/O failures
     "MXNET_CHECKPOINT_ASYNC": 1,
     "MXNET_CHECKPOINT_KEEP": 3,
     "MXNET_CHECKPOINT_BEST_K": 0,
+    "MXNET_CHECKPOINT_SHARDS": 0,
+    "MXNET_CHECKPOINT_RETRIES": 2,
+    "MXNET_CHECKPOINT_BACKOFF_S": "0.5",
     # unified telemetry (mxnet_tpu.telemetry, docs/TELEMETRY.md):
     # MXNET_TELEMETRY=0 disables step recording (watchdog beats remain);
     # MXNET_TELEMETRY_PORT=<port> starts the /metrics + /healthz HTTP

@@ -127,14 +127,27 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
             ckpt_state = ckpt_mgr.restore()
             if ckpt_state is not None:
                 from .. import random as _random
+                from ..checkpoint.state import rescale_cursor
                 if ckpt_state.meta.get("trainer") is not None:
+                    # device_put onto THIS run's mesh — an elastic
+                    # restore at a different device count reshards here
                     params, states, aux = trainer.import_training_state(
                         ckpt_state.arrays, ckpt_state.meta["trainer"])
                 if ckpt_state.meta.get("rng") is not None:
                     _random.set_state(ckpt_state.meta["rng"])
                 begin_epoch = int(ckpt_state.meta.get("epoch", 0))
                 gstep = int(ckpt_state.meta.get("step", 0))
-                ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+                ckpt_skip = rescale_cursor(ckpt_state.meta, batch)
+                saved_topo = ckpt_state.meta.get("topology") or {}
+                if saved_topo.get("device_count") is not None:
+                    import jax
+                    cur = int(jax.device_count())
+                    if int(saved_topo["device_count"]) != cur:
+                        ckpt_mgr.logger.info(
+                            "checkpoint: topology changed since save "
+                            "(%s -> %d devices); state resharded onto "
+                            "the current mesh",
+                            saved_topo["device_count"], cur)
         ckpt_mgr.install_sigterm_hook()
 
     def _ckpt_capture(next_epoch, next_batch):
@@ -146,6 +159,7 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
         return TrainingState(arrays=arrays, meta={
             "kind": "gluon_fused", "epoch": int(next_epoch),
             "batch": int(next_batch), "step": int(gstep),
+            "batch_size": int(batch),
             "trainer": tmeta, "rng": _random.get_state(),
             "amp_dtype": dtype if dtype != "float32" else None})
 

@@ -264,13 +264,27 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        ckpt_bs = int(train_data.provide_data[0].shape[0]) \
+            if getattr(train_data, "provide_data", None) else None
         gstep = 0
         ckpt_skip = 0
         if ckpt_state is not None:
-            from ..checkpoint.state import restore_module_state
+            from ..checkpoint.state import (restore_module_state,
+                                            rescale_cursor)
             restore_module_state(self, ckpt_state)
             gstep = int(ckpt_state.meta.get("step", 0))
-            ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+            # a topology change usually changes the global batch size —
+            # skip the same number of SAMPLES, not the same batch count
+            ckpt_skip = rescale_cursor(ckpt_state.meta, ckpt_bs)
+            saved_topo = ckpt_state.meta.get("topology") or {}
+            if saved_topo.get("device_count") is not None:
+                import jax
+                cur = int(jax.device_count())
+                if int(saved_topo["device_count"]) != cur:
+                    self.logger.info(
+                        "checkpoint: topology changed since save "
+                        "(%s -> %d devices); state resharded onto the "
+                        "current mesh", saved_topo["device_count"], cur)
         if ckpt_mgr is not None:
             ckpt_mgr.install_sigterm_hook()
 
@@ -298,7 +312,8 @@ class BaseModule:
             from ..checkpoint.state import capture_module_state
             ckpt_mgr.save(
                 capture_module_state(self, epoch=next_epoch,
-                                     batch=next_batch, step=gstep),
+                                     batch=next_batch, step=gstep,
+                                     batch_size=ckpt_bs),
                 step=gstep, metric=metric_val, blocking=blocking)
 
         try:

@@ -469,6 +469,9 @@ class Module(BaseModule):
                     ckpt_state.meta.get("trainer") is not None:
                 # full fused-loop state: opt-state arrays + device t/rng/
                 # loss-scaler carries — the continuation is bit-identical
+                # (import device_puts the reassembled host arrays onto
+                # THIS run's mesh, so an elastic restore at a different
+                # device count reshards here)
                 params, states, aux = trainer.import_training_state(
                     ckpt_state.arrays, ckpt_state.meta["trainer"])
             else:
@@ -480,7 +483,17 @@ class Module(BaseModule):
             if ckpt_state.meta.get("rng") is not None:
                 _random.set_state(ckpt_state.meta["rng"])
             gstep = int(ckpt_state.meta.get("step", 0))
-            ckpt_skip = int(ckpt_state.meta.get("batch", 0))
+            from ..checkpoint.state import rescale_cursor
+            ckpt_skip = rescale_cursor(ckpt_state.meta, batch_size)
+            saved_topo = ckpt_state.meta.get("topology") or {}
+            if saved_topo.get("device_count") is not None:
+                import jax
+                cur = int(jax.device_count())
+                if int(saved_topo["device_count"]) != cur:
+                    self.logger.info(
+                        "checkpoint: topology changed since save "
+                        "(%s -> %d devices); state resharded onto the "
+                        "current mesh", saved_topo["device_count"], cur)
         if ckpt_mgr is not None:
             ckpt_mgr.install_sigterm_hook()
 
@@ -533,6 +546,7 @@ class Module(BaseModule):
             return TrainingState(arrays=arrays, meta={
                 "kind": "module_fused", "epoch": int(next_epoch),
                 "batch": int(next_batch), "step": int(gstep),
+                "batch_size": int(batch_size),
                 "trainer": tmeta, "rng": _random.get_state(),
                 "amp_dtype": fit_dtype if fit_dtype != "float32"
                 else None})

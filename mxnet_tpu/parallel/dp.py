@@ -504,6 +504,7 @@ class DataParallelTrainer:
                 arrays[f"opt:{n}:{i}"] = _np.asarray(s)
         for n, a in zip(self._aux_names, aux):
             arrays[f"aux:{n}"] = _np.asarray(a)
+        from .mesh import mesh_descriptor
         meta = {
             "t": float(self._t if self._t_dev is None
                        else _np.asarray(self._t_dev)),
@@ -512,6 +513,10 @@ class DataParallelTrainer:
             "loss_scaler": None if not (self._has_ls
                                         and self._ls_dev is not None)
             else [float(x) for x in _np.asarray(self._ls_dev)],
+            # the exporting mesh, for the checkpoint TOPOLOGY record —
+            # import_training_state ignores it (device_put onto the
+            # CURRENT mesh is what reshards an elastic restore)
+            "mesh": mesh_descriptor(self._mesh),
         }
         return arrays, meta
 

@@ -57,6 +57,28 @@ def mesh_for_contexts(ctx_list):
     return mesh_for_devices([c.jax_device() for c in ctx_list])
 
 
+def mesh_descriptor(mesh):
+    """JSON-safe description of a mesh: {axis_name: size}. Recorded in
+    checkpoint TOPOLOGY.json so a restore at a different device count
+    can tell (and log) what it is resharding from."""
+    return {str(n): int(s)
+            for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def current_topology(mesh=None):
+    """JSON-safe snapshot of this process's device topology (checkpoint
+    TOPOLOGY.json): device/process counts plus the mesh axes when one is
+    given."""
+    import jax
+    d = {"device_count": int(jax.device_count()),
+         "local_device_count": int(jax.local_device_count()),
+         "process_count": int(jax.process_count()),
+         "process_index": int(jax.process_index())}
+    if mesh is not None:
+        d["mesh_axes"] = mesh_descriptor(mesh)
+    return d
+
+
 def replicated_sharding(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
     return NamedSharding(mesh, P())

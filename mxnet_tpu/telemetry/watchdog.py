@@ -110,9 +110,11 @@ def _monitor(stop):
         age = time.monotonic() - t
         if age > stall:
             _state["dumped"] = True     # re-arm on next beat
-            _counter().inc()
             dump_now(reason=f"step stalled {age:.1f}s "
                             f"(limit {stall:.1f}s)")
+            # tick AFTER the dump file is written: the counter is the
+            # "dump complete" signal observers poll on
+            _counter().inc()
 
 
 def install(stall_s=None, path=None, sigusr1=True):

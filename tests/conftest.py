@@ -72,6 +72,7 @@ _QUICK = {
     "test_amp.py::test_fp16_scaler_skips_step_and_halves_scale",
     "test_checkpoint.py::test_atomic_commit_roundtrip",
     "test_checkpoint.py::test_module_fit_resume_bit_identical",
+    "test_checkpoint.py::test_sharded_split0_and_whole_placement",
     "test_telemetry.py::test_registry_absorbs_profiler_hooks_and_dedups",
     "test_telemetry.py::test_exporter_scrape_during_live_fit",
     "test_telemetry.py::test_watchdog_stall_dump_and_rearm",

@@ -1,12 +1,21 @@
-"""mxnet_tpu.checkpoint — fault-tolerant async checkpointing (ISSUE 5).
+"""mxnet_tpu.checkpoint — fault-tolerant async checkpointing (ISSUE 5)
+plus the topology-elastic sharded layout (ISSUE 8).
 
 Covers the subsystem's contracts on the CPU backend:
-  - atomic commit protocol: step dir + checksummed MANIFEST, no staging
+  - atomic commit protocol: step dir of per-shard dirs (checksummed
+    shard MANIFESTs + the TOPOLOGY.json seal written last), no staging
     leftovers, full TrainingState roundtrip (incl. the arrays.pkl
     fallback for bfloat16 payloads the nd container predates);
-  - retention: keep-last-N plus best-k-by-metric;
-  - a corrupt newest checkpoint falls back to the previous committed
-    step instead of failing the restore;
+  - elastic sharding: split0/whole placement, restore reassembly, the
+    shard-count-independent state_sha256, resume across a changed
+    MXNET_CHECKPOINT_SHARDS, rescale_cursor on a changed global batch;
+  - retention: keep-last-N plus best-k-by-metric — counted per COMMIT,
+    not per shard file;
+  - a corrupt/missing shard in the newest checkpoint falls back to the
+    previous committed step instead of failing the restore
+    (ckpt_fallback_total); transient shard I/O retries with backoff
+    (ckpt_retry_total, MXNET_CHECKPOINT_INJECT_IO_FAIL);
+  - format-1 (single-MANIFEST, PR 5) dirs stay restorable;
   - `Module.fit(checkpoint_dir=..., resume=True)` continues
     BIT-IDENTICALLY vs an uninterrupted run — per-batch path, fused
     steps_per_dispatch>1 path, and fused + bf16 amp;
@@ -32,7 +41,19 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.checkpoint import (CheckpointManager, TrainingState,
-                                  capture_module_state)
+                                  capture_module_state, rescale_cursor,
+                                  state_sha256)
+
+
+def _payload_files(step_dir):
+    """All array payload files under a committed step dir (shard layout:
+    step-N/shard-K-of-M/arrays.*)."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for f in sorted(files):
+            if f.startswith("arrays"):
+                out.append(os.path.join(root, f))
+    return sorted(out)
 
 
 def _mlp_sym():
@@ -83,16 +104,28 @@ def test_atomic_commit_roundtrip(tmp_path):
                        opt_states={0: (mx.nd.array(w),)},
                        meta={"epoch": 1, "batch": 0, "step": 7})
     mgr.save(st, step=7, metric=0.5)
-    # layout: committed dir with MANIFEST, no staging leftovers
+    # layout: committed dir with per-shard manifests + the TOPOLOGY seal,
+    # no staging leftovers
     assert sorted(os.listdir(d)) == ["step-0000000007"]
-    manifest = json.loads(
-        (tmp_path / "ckpt" / "step-0000000007" / "MANIFEST.json")
-        .read_text())
-    assert manifest["step"] == 7 and manifest["metric"] == 0.5
-    assert set(manifest["files"]) == {"arrays.nd", "optimizer.bin"}
-    # arrays.nd stays nd.load-inspectable (the reference container)
-    loaded = mx.nd.load(str(tmp_path / "ckpt" / "step-0000000007"
-                            / "arrays.nd"))
+    step_dir = tmp_path / "ckpt" / "step-0000000007"
+    topo = json.loads((step_dir / "TOPOLOGY.json").read_text())
+    assert topo["step"] == 7 and topo["metric"] == 0.5
+    assert topo["format"] == 2 and topo["shards"]
+    assert len(topo["shards"]) == mgr.num_shards
+    for sname in topo["shards"]:
+        assert (step_dir / sname / "MANIFEST.json").is_file()
+    # every array is placed by the shard map; (3,4) doesn't divide the
+    # shard count so both land whole, and the optimizer pickle is shard 0
+    assert set(topo["shard_map"]) == {"param:w", "aux:m"}
+    shard0 = f"shard-{0:05d}-of-{mgr.num_shards:05d}"
+    s0_manifest = json.loads((step_dir / shard0 / "MANIFEST.json")
+                             .read_text())
+    assert "optimizer.bin" in s0_manifest["files"]
+    # whole-array shards stay nd.load-inspectable (reference container)
+    place = topo["shard_map"]["param:w"]
+    assert place["mode"] == "whole"
+    w_shard = f"shard-{place['shard']:05d}-of-{mgr.num_shards:05d}"
+    loaded = mx.nd.load(str(step_dir / w_shard / "arrays.nd"))
     assert np.array_equal(loaded["param:w"].asnumpy(), w)
     # full roundtrip through restore()
     back = mgr.restore()
@@ -115,8 +148,10 @@ def test_bfloat16_payload_falls_back_to_pickle(tmp_path):
     mgr.save(TrainingState(arrays={"param:w": w},
                            meta={"epoch": 0, "batch": 0, "step": 1}),
              step=1)
-    files = os.listdir(os.path.join(d, "step-0000000001"))
-    assert "arrays.pkl" in files and "arrays.nd" not in files
+    payloads = _payload_files(os.path.join(d, "step-0000000001"))
+    assert payloads, "no array payload written"
+    assert all(p.endswith("arrays.pkl") for p in payloads), \
+        "bfloat16 must take the pickle fallback in every shard"
     back = mgr.restore()
     assert back.arrays["param:w"].dtype == w.dtype
     assert np.array_equal(np.asarray(back.arrays["param:w"],
@@ -137,16 +172,50 @@ def test_retention_keep_last_and_best_k(tmp_path):
     mgr.close()
 
 
-def test_corrupt_latest_falls_back(tmp_path):
+def test_corrupt_one_shard_falls_back(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=0,
                             async_save=False)
     for s in (1, 2):
         mgr.save(TrainingState(arrays={"param:w": np.float32([s])},
                                meta={"epoch": s, "batch": 0, "step": s}),
                  step=s)
-    with open(tmp_path / "ckpt" / "step-0000000002" / "arrays.nd",
-              "r+b") as f:
+    # bit-rot ONE shard payload of the newest commit
+    victim = _payload_files(tmp_path / "ckpt" / "step-0000000002")[0]
+    with open(victim, "r+b") as f:
         f.write(b"garbage")
+    back = mgr.restore()
+    assert back is not None and back.step == 1
+    assert mgr.counters()["ckpt_fallback_total"] >= 1
+    mgr.close()
+
+
+def test_missing_shard_file_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=0,
+                            async_save=False)
+    for s in (1, 2):
+        mgr.save(TrainingState(arrays={"param:w": np.float32([s])},
+                               meta={"epoch": s, "batch": 0, "step": s}),
+                 step=s)
+    # delete a payload the shard manifest still lists: the shard SET is
+    # incomplete against TOPOLOGY.json, so restore must not crash with a
+    # FileNotFoundError — it skips the commit and falls back a step
+    os.unlink(_payload_files(tmp_path / "ckpt" / "step-0000000002")[0])
+    back = mgr.restore()
+    assert back is not None and back.step == 1
+    assert mgr.counters()["ckpt_fallback_total"] >= 1
+    mgr.close()
+
+
+def test_missing_shard_dir_falls_back(tmp_path):
+    import shutil
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=0,
+                            async_save=False, num_shards=4)
+    for s in (1, 2):
+        mgr.save(TrainingState(
+            arrays={"param:w": np.arange(8, dtype=np.float32)},
+            meta={"epoch": s, "batch": 0, "step": s}), step=s)
+    shutil.rmtree(tmp_path / "ckpt" / "step-0000000002"
+                  / "shard-00002-of-00004")
     back = mgr.restore()
     assert back is not None and back.step == 1
     mgr.close()
@@ -179,6 +248,157 @@ def test_save_rejects_non_training_state(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
     with pytest.raises(TypeError):
         mgr.save({"param:w": np.zeros(3)}, step=1)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic sharding (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def test_sharded_split0_and_whole_placement(tmp_path):
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, keep_last_n=0, async_save=False,
+                            num_shards=4)
+    big = np.arange(16, dtype=np.float32).reshape(8, 2)   # 8 % 4 == 0
+    odd = np.arange(6, dtype=np.float32).reshape(3, 2)    # 3 < 4
+    mgr.save(TrainingState(arrays={"param:big": big, "param:odd": odd},
+                           meta={"epoch": 0, "batch": 0, "step": 1}),
+             step=1)
+    step_dir = tmp_path / "ckpt" / "step-0000000001"
+    topo = json.loads((step_dir / "TOPOLOGY.json").read_text())
+    assert topo["shard_map"]["param:big"] == {"mode": "split0"}
+    assert topo["shard_map"]["param:odd"]["mode"] == "whole"
+    assert topo["topology"]["num_shards"] == 4
+    # part k of the split array lives in shard k
+    for k in range(4):
+        loaded = mx.nd.load(str(step_dir / f"shard-{k:05d}-of-00004"
+                                / "arrays.nd"))
+        assert np.array_equal(loaded["param:big"].asnumpy(),
+                              big[2 * k:2 * k + 2])
+    back = mgr.restore()
+    assert np.array_equal(np.asarray(back.arrays["param:big"]), big)
+    assert np.array_equal(np.asarray(back.arrays["param:odd"]), odd)
+    mgr.close()
+
+
+def test_state_sha256_is_shard_count_independent(tmp_path):
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    b = np.float32([1.0, 2.0, 3.0])
+    shas = set()
+    for n in (1, 2, 8):
+        d = str(tmp_path / f"ckpt{n}")
+        mgr = CheckpointManager(d, keep_last_n=0, async_save=False,
+                                num_shards=n)
+        mgr.save(TrainingState(arrays={"param:w": w, "param:b": b},
+                               opt_states={0: (mx.nd.array(w),)},
+                               meta={"epoch": 0, "batch": 0, "step": 1}),
+                 step=1)
+        shas.add(state_sha256(mgr.restore()))
+        mgr.close()
+    assert len(shas) == 1, \
+        "restored state must hash equal no matter the shard count"
+
+
+def test_retention_counts_commits_not_shard_files(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=2,
+                            async_save=False, num_shards=4)
+    for s in range(1, 6):
+        mgr.save(TrainingState(
+            arrays={"param:w": np.full((8, 2), s, np.float32)},
+            meta={"epoch": s, "batch": 0, "step": s}), step=s)
+    # 5 commits x 4 shard dirs on disk, but retention counts COMMITS
+    assert mgr.steps() == [4, 5]
+    assert mgr.counters()["ckpt_retained"] == 2
+    mgr.close()
+
+
+def test_transient_io_failure_retries_and_succeeds(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_CHECKPOINT_INJECT_IO_FAIL", "2")
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "2")
+    monkeypatch.setenv("MXNET_CHECKPOINT_BACKOFF_S", "0.01")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=0,
+                            async_save=False)
+    mgr.save(TrainingState(arrays={"param:w": np.float32([1.0])},
+                           meta={"epoch": 0, "batch": 0, "step": 1}),
+             step=1)
+    c = mgr.counters()
+    assert c["ckpt_commits"] == 1 and c["ckpt_retry_total"] == 2
+    assert mgr.restore() is not None
+    mgr.close()
+
+
+def test_io_failure_past_retry_budget_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_CHECKPOINT_INJECT_IO_FAIL", "3")
+    monkeypatch.setenv("MXNET_CHECKPOINT_RETRIES", "1")
+    monkeypatch.setenv("MXNET_CHECKPOINT_BACKOFF_S", "0.01")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=0,
+                            async_save=False)
+    with pytest.raises(OSError):
+        mgr.save(TrainingState(arrays={"param:w": np.float32([1.0])},
+                               meta={"epoch": 0, "batch": 0, "step": 1}),
+                 step=1)
+    mgr.close()
+
+
+def test_legacy_format1_dir_still_restores(tmp_path):
+    import hashlib
+    # hand-build a PR 5 single-MANIFEST step dir
+    d = tmp_path / "ckpt"
+    step_dir = d / "step-0000000003"
+    step_dir.mkdir(parents=True)
+    payload = pickle.dumps({"param:w": np.float32([7.0, 8.0])})
+    (step_dir / "arrays.pkl").write_bytes(payload)
+    (step_dir / "MANIFEST.json").write_text(json.dumps({
+        "format": 1, "step": 3, "metric": 0.25,
+        "meta": {"epoch": 1, "batch": 0, "step": 3},
+        "files": {"arrays.pkl": {
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "bytes": len(payload)}}}))
+    mgr = CheckpointManager(str(d), async_save=False)
+    assert mgr.steps() == [3]
+    back = mgr.restore()
+    assert back.step == 3 and back.metric == 0.25
+    assert np.array_equal(np.asarray(back.arrays["param:w"]),
+                          np.float32([7.0, 8.0]))
+    mgr.close()
+
+
+def test_rescale_cursor_maps_samples_not_batches():
+    # same batch size (or unrecorded): cursor unchanged — the
+    # bit-identical same-topology path
+    assert rescale_cursor({"batch": 3, "batch_size": 8}, 8) == 3
+    assert rescale_cursor({"batch": 3}, 8) == 3
+    assert rescale_cursor({"batch": 3, "batch_size": 8}, None) == 3
+    # halved device count doubles per-step samples consumed per batch
+    # slot: 3 batches of 8 samples == 24 samples == 6 batches of 4
+    assert rescale_cursor({"batch": 3, "batch_size": 8}, 4) == 6
+    assert rescale_cursor({"batch": 6, "batch_size": 4}, 8) == 3
+    # non-divisible boundary rounds DOWN (replay, never skip)
+    assert rescale_cursor({"batch": 5, "batch_size": 6}, 8) == 3
+
+
+def test_resume_across_shard_counts_bit_identical(tmp_path, monkeypatch):
+    base = _fit(str(tmp_path / "base"), num_epoch=4)
+    monkeypatch.setenv("MXNET_CHECKPOINT_SHARDS", "8")
+    _fit(str(tmp_path / "split"), num_epoch=2)
+    monkeypatch.setenv("MXNET_CHECKPOINT_SHARDS", "2")
+    resumed = _fit(str(tmp_path / "split"), num_epoch=4, resume=True)
+    assert _params_bytes(base) == _params_bytes(resumed)
+
+
+def test_fused_resume_across_shard_counts_bit_identical(tmp_path,
+                                                        monkeypatch):
+    base = _fit(str(tmp_path / "base"), num_epoch=4, steps_per_dispatch=2)
+    monkeypatch.setenv("MXNET_CHECKPOINT_SHARDS", "8")
+    _fit(str(tmp_path / "split"), num_epoch=2, steps_per_dispatch=2)
+    monkeypatch.setenv("MXNET_CHECKPOINT_SHARDS", "2")
+    resumed = _fit(str(tmp_path / "split"), num_epoch=4, resume=True,
+                   steps_per_dispatch=2)
+    assert _params_bytes(base) == _params_bytes(resumed)
+    mgr = CheckpointManager(str(tmp_path / "split"))
+    st = mgr.restore()
+    assert st.meta["kind"] == "module_fused"
+    assert st.meta["batch_size"] == 8
     mgr.close()
 
 

@@ -39,6 +39,10 @@ case "$stage" in
     echo "== checkpoint smoke (crash injection: SIGKILL mid-commit, resume)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.checkpoint --selftest
+    echo "== elastic checkpoint smoke (SIGKILL at 4 devices, resume at 2)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.checkpoint --selftest --elastic \
+        --devices-a 4 --devices-b 2
     echo "== telemetry smoke (registry/scrape/JSONL/overhead/watchdog)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.telemetry --selftest ;;
