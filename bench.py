@@ -1096,6 +1096,31 @@ def _telemetry_lane():
             "devices": n}
 
 
+def _analysis_lane():
+    """Static-analysis gate as a measured lane (mxnet_tpu.analysis,
+    ISSUE 9): one `python -m mxnet_tpu.analysis --strict --json`
+    subprocess — the same command ci.sh quick runs — timed wall-clock,
+    with the finding counts on record. The strict gate passing inside
+    the bench run proves the analysis invariants hold on the EXACT tree
+    being benchmarked."""
+    import subprocess
+    import sys
+    from mxnet_tpu.analysis.hloaudit import parse_last_metric
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--strict",
+         "--json"], capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    wall_s = time.perf_counter() - t0
+    rec = parse_last_metric(proc.stdout, "analysis")
+    return {"strict_ok": proc.returncode == 0,
+            "wall_s": round(wall_s, 1),
+            "counts": rec.get("counts"),
+            "suppressed": rec.get("suppressed"),
+            "strict_failures": rec.get("strict_failures")}
+
+
 def main(argv=None):
     import argparse
 
@@ -1319,6 +1344,14 @@ def main(argv=None):
     except Exception as e:
         tele_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("telemetry", tele_lane)
+    # static-analysis strict gate, timed (ISSUE 9)
+    try:
+        analysis_lane = _gated("analysis", 150, _analysis_lane)
+    except _BudgetExceeded:
+        analysis_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        analysis_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("analysis", analysis_lane)
     acc_fail = None
     try:
         # the accuracy lane ASSERTS its target — never shed silently in a

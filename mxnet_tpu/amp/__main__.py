@@ -176,8 +176,6 @@ def hlo_check(dtype="bfloat16"):
     wire dtype the partitioner chose on every backend. Must run in a
     fresh process: --xla_dump_to is read once at backend init.
     """
-    import glob
-    import re
     import tempfile
     dump = tempfile.mkdtemp(prefix="amp_hlo_")
     os.environ["XLA_FLAGS"] = (
@@ -199,18 +197,11 @@ def hlo_check(dtype="bfloat16"):
     inputs = tr.shard_inputs([x, y])
     params, states, aux, _, _ = tr.step(params, states, aux, inputs)
 
-    ars = []
-    for f in sorted(glob.glob(dump + "/*jit_step*after_spmd-"
-                                     "partitioning*")):
-        for m in re.finditer(r"=\s*(\w+)\[([\d,]*)\][^=]*?all-reduce\(",
-                             open(f).read()):
-            ars.append([m.group(1), m.group(2)])
-    itemsize = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8}
+    # HLO matching lives in ONE place: the analysis auditor's helpers
+    from mxnet_tpu.analysis.hloaudit import spmd_allreduces, wire_bytes
+    ars = spmd_allreduces(dump, "jit_step")
     grad_ars = [a for a in ars if a[1]]    # non-scalar = gradient tensors
-    ar_bytes = sum(
-        itemsize.get(dt, 4) * int(np.prod([int(d) for d in
-                                           shape.split(",")]))
-        for dt, shape in grad_ars)
+    ar_bytes = wire_bytes(grad_ars)
     want = {"bfloat16": "bf16", "float16": "f16",
             "float32": "f32"}[dtype]
     master_f32 = all(str(p.dtype) == "float32" for p in params) and all(

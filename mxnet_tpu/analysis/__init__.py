@@ -1,0 +1,149 @@
+"""mxnet_tpu.analysis — static analysis over the framework itself.
+
+Three pass families, one finding model, one baseline file:
+
+  - ``tracelint``  AST passes that flag trace-impurity hazards inside
+    functions traced by jax (host syncs on traced values, wall-clock/RNG
+    reads baked into the trace, Python-side state mutation);
+  - ``locklint``   a concurrency audit across every ``threading.Thread``/
+    ``Lock`` site: lock-order cycles and unlocked writes to state shared
+    between threads (modules declare intentionally lock-free surfaces in
+    a small ``__analysis_thread_safe__`` annotation table the pass
+    consumes);
+  - ``hloaudit``   compiles a matrix of representative programs and
+    asserts post-SPMD HLO properties (half-width amp collectives, buffer
+    donation on the fused step, no f64, convert/recompile budgets).
+
+Findings are typed (``rule``, ``severity``, ``file:line``) and
+suppressible through ``tools/analysis_baseline.json``; the CLI
+(``python -m mxnet_tpu.analysis --strict``) exits non-zero on any
+unsuppressed P0/P1 — wired into ``tools/ci.sh quick`` so every PR lands
+against machine-checked invariants. See docs/ANALYSIS.md for the rule
+catalog.
+
+Severities: P0 = definite bug (deadlock cycle, broken compiler
+invariant), P1 = likely bug (unlocked cross-thread write, host sync on a
+traced value), P2 = advisory (accepted P2s live in the baseline).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["Finding", "load_baseline", "save_baseline", "default_baseline_path",
+           "strict_default", "suppress", "strict_failures", "package_root",
+           "DEFAULT_HLO_BUDGETS"]
+
+_SEVERITIES = ("P0", "P1", "P2")
+
+# per-program HLO budgets used when the baseline does not pin them
+# (hloaudit records the measured value in its findings so --write-baseline
+# can tighten these over time)
+DEFAULT_HLO_BUDGETS = {
+    "fit_step_fp32": {"convert_max": 8, "recompile_max": 1},
+    "fit_step_bf16": {"convert_max": 120, "recompile_max": 1},
+    "serving_bucket": {"convert_max": 4, "recompile_max": 1},
+}
+
+
+class Finding:
+    """One typed analysis finding.
+
+    ``key()`` identifies the finding for baseline suppression: rule +
+    file + enclosing scope (qualname), NOT the line number — baselines
+    survive unrelated edits above the flagged site.
+    """
+
+    __slots__ = ("rule", "severity", "file", "line", "scope", "message")
+
+    def __init__(self, rule, severity, file, line, message, scope=""):
+        if severity not in _SEVERITIES:
+            raise ValueError(f"severity {severity!r} not in {_SEVERITIES}")
+        self.rule = rule
+        self.severity = severity
+        self.file = file
+        self.line = int(line)
+        self.scope = scope
+        self.message = message
+
+    def key(self):
+        return f"{self.rule}::{self.file}::{self.scope}"
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "file": self.file, "line": self.line, "scope": self.scope,
+                "message": self.message, "key": self.key()}
+
+    def __repr__(self):
+        return (f"[{self.severity}] {self.rule} {self.file}:{self.line}"
+                f" ({self.scope}) {self.message}")
+
+
+def package_root():
+    """Directory of the mxnet_tpu package — the default scan root."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path():
+    """tools/analysis_baseline.json next to the package, overridable via
+    MXNET_ANALYSIS_BASELINE."""
+    env = os.environ.get("MXNET_ANALYSIS_BASELINE")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(package_root()), "tools",
+                        "analysis_baseline.json")
+
+
+def strict_default():
+    """MXNET_ANALYSIS_STRICT=1 makes --strict the CLI default."""
+    from .. import config
+    return config.flag("MXNET_ANALYSIS_STRICT")
+
+
+def load_baseline(path=None):
+    """{"suppress": [finding keys], "hlo_budgets": {program: {...}}} —
+    an absent/empty file is an empty baseline, never an error."""
+    path = path or default_baseline_path()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return {"suppress": [], "hlo_budgets": {}}
+    return {"suppress": list(raw.get("suppress") or []),
+            "hlo_budgets": dict(raw.get("hlo_budgets") or {})}
+
+
+def save_baseline(baseline, path=None):
+    path = path or default_baseline_path()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    payload = {"suppress": sorted(set(baseline.get("suppress") or [])),
+               "hlo_budgets": baseline.get("hlo_budgets") or {}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def suppress(findings, baseline):
+    """Split into (active, suppressed) against the baseline's key set."""
+    keys = set(baseline.get("suppress") or [])
+    active, suppressed = [], []
+    for f in findings:
+        (suppressed if f.key() in keys else active).append(f)
+    return active, suppressed
+
+
+def strict_failures(findings, baseline=None):
+    """The findings that make --strict exit non-zero: unsuppressed
+    P0/P1. P2s never fail strict — they are burn-down material."""
+    active = findings if baseline is None else suppress(findings,
+                                                        baseline)[0]
+    return [f for f in active if f.severity in ("P0", "P1")]
+
+
+def hlo_budget(baseline, program):
+    """Effective budget for one hloaudit program: baseline overrides
+    the shipped defaults key-by-key."""
+    out = dict(DEFAULT_HLO_BUDGETS.get(program, {}))
+    out.update((baseline or {}).get("hlo_budgets", {}).get(program, {}))
+    return out

@@ -1,0 +1,98 @@
+"""Analysis CLI — the ci.sh quick gate.
+
+    python -m mxnet_tpu.analysis [--strict] [--json] [--skip-hlo]
+                                 [--baseline PATH] [--write-baseline]
+
+Runs all three pass families (tracelint + locklint over the package
+source, hloaudit over freshly compiled programs), suppresses findings
+listed in tools/analysis_baseline.json, prints the rest, and — under
+``--strict`` (or MXNET_ANALYSIS_STRICT=1) — exits non-zero if any
+unsuppressed P0/P1 remains. P2s never fail strict; they are burn-down
+material tracked in the baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (default_baseline_path, load_baseline, package_root,
+               save_baseline, strict_default, strict_failures, suppress)
+from . import locklint, tracelint
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="trace-purity lint, concurrency audit and HLO "
+                    "invariant auditor (docs/ANALYSIS.md)")
+    ap.add_argument("--strict", action="store_true", default=None,
+                    help="exit non-zero on unsuppressed P0/P1 (default "
+                         "when MXNET_ANALYSIS_STRICT=1)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default MXNET_ANALYSIS_BASELINE "
+                         "or tools/analysis_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record every current finding key as suppressed "
+                         "and exit 0 (burn-down bookkeeping, not a fix)")
+    ap.add_argument("--root", default=None,
+                    help="source tree to scan (default: the installed "
+                         "mxnet_tpu package)")
+    ap.add_argument("--skip-hlo", action="store_true",
+                    help="source passes only — skip the program-compile "
+                         "auditor (fast, no jax backend spun up)")
+    args = ap.parse_args(argv)
+
+    strict = strict_default() if args.strict is None else args.strict
+    root = args.root or package_root()
+    bpath = args.baseline or default_baseline_path()
+    baseline = load_baseline(bpath)
+
+    findings = tracelint.scan_tree(root) + locklint.scan_tree(root)
+    if not args.skip_hlo:
+        from . import hloaudit
+        findings += hloaudit.run(baseline)
+    findings.sort(key=lambda f: (f.severity, f.file, f.line, f.rule))
+    active, suppressed = suppress(findings, baseline)
+    failures = strict_failures(findings, baseline)
+
+    if args.write_baseline:
+        keys = sorted({f.key() for f in findings}
+                      | set(baseline.get("suppress") or []))
+        baseline["suppress"] = keys
+        save_baseline(baseline, bpath)
+        print(f"analysis: baseline now suppresses {len(keys)} finding "
+              f"keys -> {bpath}")
+        return 0
+
+    counts = {"P0": 0, "P1": 0, "P2": 0}
+    for f in active:
+        counts[f.severity] += 1
+    if args.json:
+        print(json.dumps({
+            "metric": "analysis",
+            "findings": [f.to_dict() for f in active],
+            "counts": counts,
+            "suppressed": len(suppressed),
+            "strict": bool(strict),
+            "strict_failures": len(failures),
+            "baseline": bpath,
+            "ok": not (strict and failures),
+        }), flush=True)
+    else:
+        for f in active:
+            print(f)
+        print(f"analysis: {len(active)} findings ({counts['P0']} P0, "
+              f"{counts['P1']} P1, {counts['P2']} P2), "
+              f"{len(suppressed)} suppressed by {bpath}")
+        if strict and failures:
+            print(f"analysis: STRICT FAIL — {len(failures)} unsuppressed "
+                  f"P0/P1 (fix them or, for accepted P2-grade debt, "
+                  f"--write-baseline)", file=sys.stderr)
+    return 1 if (strict and failures) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,358 @@
+"""hloaudit — compiled-program invariant auditor.
+
+Where tracelint/locklint read the *source*, this pass compiles a matrix
+of representative programs and asserts properties of the *artifact* —
+the post-SPMD / optimized HLO the partitioner actually emits:
+
+  - ``fit_step_fp32`` / ``fit_step_bf16``  the fused K=2 training step
+    (``DataParallelTrainer._multi_step_fn``) on a 2-device cpu mesh:
+    gradient all-reduce present and (where async) start/done paired,
+    params+optimizer-states donated, no f64, convert count and
+    recompile count within the per-program budget;
+  - ``serving_bucket``  one bucketed serving plan
+    (``ServingEngine._plan``): no f64, convert/recompile budgets;
+  - the PR-4 amp wire invariant: the bf16 gradient all-reduce moves
+    EXACTLY half the wire bytes of the fp32 one (two
+    ``python -m mxnet_tpu.amp --hlo-check`` subprocess runs).
+
+The compile half runs in a fresh subprocess (``--audit-programs``):
+device pinning and XLA dump flags are consumed once at backend init,
+so the auditing process must own its backend from birth — the parent
+only parses the JSON report. The text helpers below are the single
+home of the repo's HLO-matching code; ``__graft_entry__`` and
+``mxnet_tpu.amp.__main__`` import them rather than re-growing regexes.
+
+Budgets come from ``hlo_budget(baseline, program)`` — the shipped
+defaults in ``analysis.DEFAULT_HLO_BUDGETS``, overridable key-by-key in
+``tools/analysis_baseline.json`` under ``hlo_budgets``.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+from . import Finding, hlo_budget, package_root
+
+__all__ = ["allreduce_counts", "allreduce_pairing_ok", "has_f64",
+           "convert_count", "donated_param_indices", "spmd_allreduces",
+           "wire_bytes", "parse_last_metric", "audit_findings",
+           "findings_from_report", "amp_wire_findings", "run",
+           "ITEMSIZE", "PROGRAMS"]
+
+ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8}
+
+PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "serving_bucket")
+
+# where each audited program's defining code lives (finding file field)
+_PROGRAM_FILE = {
+    "fit_step_fp32": "parallel/dp.py",
+    "fit_step_bf16": "parallel/dp.py",
+    "serving_bucket": "serving/engine.py",
+}
+
+
+# -- pure HLO-text helpers ---------------------------------------------------
+# (no jax imports: unit-testable on strings, importable everywhere)
+
+def allreduce_counts(hlo):
+    """(n_sync, n_async) all-reduces in one HLO module text. Async pairs
+    (all-reduce-start/-done) are how TPU/GPU backends hide the collective
+    behind compute; the cpu backend lowers the synchronous form."""
+    return hlo.count("all-reduce("), hlo.count("all-reduce-start")
+
+
+def allreduce_pairing_ok(hlo):
+    """Every all-reduce-start has a matching all-reduce-done."""
+    return hlo.count("all-reduce-done") == hlo.count("all-reduce-start")
+
+
+def has_f64(hlo):
+    """Any f64 tensor anywhere in the module — the framework is fp32/
+    half-precision only; f64 means a silent numpy float64 leaked in."""
+    return re.search(r"\bf64\[", hlo) is not None
+
+
+def convert_count(hlo):
+    """Number of convert ops — the dtype-cast traffic amp is supposed to
+    keep fused and bounded."""
+    return len(re.findall(r"\bconvert\(", hlo))
+
+
+def donated_param_indices(hlo):
+    """Parameter indices donated to outputs, from the HloModule header's
+    ``input_output_alias={ {out}: (param, {}, may-alias), ... }`` map.
+    Balanced-brace scan: the map's values nest braces, so a regex over
+    the whole header would stop at the first ``}``."""
+    start = hlo.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = hlo.index("{", start)
+    depth, j = 0, i
+    while j < len(hlo):
+        if hlo[j] == "{":
+            depth += 1
+        elif hlo[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    blob = hlo[i:j + 1]
+    return {int(m.group(1)) for m in re.finditer(r"\(\s*(\d+)\s*,", blob)}
+
+
+def spmd_allreduces(dump_dir, module_substr="jit_step"):
+    """[(dtype, "d0,d1,...")] for every all-reduce in the POST-SPMD-
+    PARTITIONING dump of modules matching ``module_substr``. This is the
+    pass that inserts the collectives; later backend legalization may
+    re-widen them (cpu promotes bf16 to f32), so only this dump shows
+    the wire dtype the partitioner chose."""
+    ars = []
+    pat = os.path.join(dump_dir,
+                       f"*{module_substr}*after_spmd-partitioning*")
+    for f in sorted(glob.glob(pat)):
+        with open(f, encoding="utf-8") as fh:
+            text = fh.read()
+        for m in re.finditer(r"=\s*(\w+)\[([\d,]*)\][^=]*?all-reduce\(",
+                             text):
+            ars.append([m.group(1), m.group(2)])
+    return ars
+
+
+def wire_bytes(ars):
+    """Total bytes moved by [(dtype, shape-csv)] collectives."""
+    total = 0
+    for dt, shape in ars:
+        n = 1
+        for d in shape.split(","):
+            if d:
+                n *= int(d)
+        total += ITEMSIZE.get(dt, 4) * n
+    return total
+
+
+def parse_last_metric(stdout, metric):
+    """Last JSON line in ``stdout`` whose "metric" field matches, or {}.
+    Selftest CLIs print exactly one such line; anything else on stdout
+    (warnings, progress) is skipped."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == metric:
+            return rec
+    return {}
+
+
+# -- the compile half (fresh-subprocess body) --------------------------------
+
+def _audit_programs():
+    """Compile the program matrix and print ONE ``hlo_audit`` JSON line.
+    Must run in a process whose jax backend it owns (``_pin_cpu`` before
+    the first jax import)."""
+    from mxnet_tpu.amp.__main__ import _pin_cpu, _mlp_sym, _trainer
+    _pin_cpu(2)
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import data_parallel_mesh
+
+    out = {"metric": "hlo_audit", "programs": {}}
+    mesh = data_parallel_mesh(2, jax.devices()[:2])
+    # stacked (K=2, batch, ...) blocks for the fused step
+    xk = np.zeros((2, 16, 8), np.float32)
+    yk = np.zeros((2, 16), np.float32)
+
+    for name, dtype in (("fit_step_fp32", "float32"),
+                        ("fit_step_bf16", "bfloat16")):
+        tr = _trainer(dtype, mesh)
+        params, states, aux = tr.init_state({"data": (16, 8),
+                                             "softmax_label": (16,)})
+        stacked = tr.shard_inputs([xk, yk], stacked=True)
+        tr._ensure_dev_state(None)
+        fn = tr._multi_step_fn(2, "none")
+        hlo = fn.lower(params, states, aux, stacked, tr._rng_dev,
+                       tr._lr_dev, tr._t_dev).compile().as_text()
+        n_sync, n_async = allreduce_counts(hlo)
+        donated = donated_param_indices(hlo)
+        # donate_argnums=(0, 1): every params + optimizer-state leaf
+        # must be aliased to an output or the fused loop double-buffers
+        n_leaves = len(jax.tree_util.tree_leaves((params, states)))
+        # recompile check: two same-shape dispatches, ONE executable
+        p2, s2, a2, _, _ = tr.step_k(params, states, aux, stacked)
+        tr.step_k(p2, s2, a2, tr.shard_inputs([xk, yk], stacked=True))
+        out["programs"][name] = {
+            "allreduce_sync": n_sync,
+            "allreduce_async": n_async,
+            "pairing_ok": allreduce_pairing_ok(hlo),
+            "has_f64": has_f64(hlo),
+            "convert_count": convert_count(hlo),
+            "donated": sorted(donated),
+            "donate_expected": n_leaves,
+            "recompiles": int(fn._cache_size()),
+        }
+
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    args, auxs = mod.get_params()
+    from mxnet_tpu.serving import ServingEngine
+    eng = ServingEngine.from_symbol(sym, args, auxs, {"data": (8, 8)},
+                                    warmup=False)
+    bucket = eng.buckets[0]          # smallest bucket: pad-and-slice plan
+    arrays = [np.zeros((bucket, 8), np.float32)]
+    plan = eng._plan(bucket)
+    hlo = plan.lower(tuple(arrays), tuple(eng._pred._state),
+                     eng._pred._rng).compile().as_text()
+    eng.infer(arrays[0])
+    eng.infer(arrays[0])
+    out["programs"]["serving_bucket"] = {
+        "allreduce_sync": hlo.count("all-reduce("),
+        "allreduce_async": hlo.count("all-reduce-start"),
+        "pairing_ok": allreduce_pairing_ok(hlo),
+        "has_f64": has_f64(hlo),
+        "convert_count": convert_count(hlo),
+        "donated": [],
+        "donate_expected": 0,        # serving plans donate nothing
+        "recompiles": int(plan._cache_size()),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+# -- host-side driver: subprocess -> findings --------------------------------
+
+def _sub(args, timeout):
+    return subprocess.run(
+        [sys.executable, "-m"] + args, capture_output=True, text=True,
+        timeout=timeout, cwd=os.path.dirname(package_root()))
+
+
+def audit_findings(baseline=None, timeout=900):
+    """Run the program-matrix audit in a fresh subprocess and map its
+    report onto findings. One P1 ``hlo-audit-error`` if the subprocess
+    itself dies (an unbuildable program is a finding, not a crash)."""
+    proc = _sub(["mxnet_tpu.analysis.hloaudit", "--audit-programs"],
+                timeout)
+    rec = parse_last_metric(proc.stdout, "hlo_audit")
+    if proc.returncode != 0 or not rec.get("programs"):
+        return [Finding(
+            "hlo-audit-error", "P1", "analysis/hloaudit.py", 0,
+            f"program audit subprocess failed rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout or '')[-400:]}",
+            scope="audit-programs")]
+    return findings_from_report(rec, baseline)
+
+
+def findings_from_report(rec, baseline=None):
+    """Map one ``hlo_audit`` report onto findings (separated from the
+    subprocess plumbing so tests can feed synthetic reports)."""
+    baseline = baseline or {}
+    findings = []
+    for prog in sorted(rec["programs"]):
+        r = rec["programs"][prog]
+        bud = hlo_budget(baseline, prog)
+        file = _PROGRAM_FILE.get(prog, "analysis/hloaudit.py")
+        n_ar = r["allreduce_sync"] + r["allreduce_async"]
+        if prog.startswith("fit_step") and n_ar == 0:
+            findings.append(Finding(
+                "hlo-missing-allreduce", "P0", file, 0,
+                f"{prog}: no gradient all-reduce in the compiled "
+                f"2-device step — data parallelism is not happening",
+                scope=prog))
+        if not r["pairing_ok"]:
+            findings.append(Finding(
+                "hlo-allreduce-pairing", "P0", file, 0,
+                f"{prog}: unpaired all-reduce-start in optimized HLO",
+                scope=prog))
+        if r["has_f64"]:
+            findings.append(Finding(
+                "hlo-f64", "P1", file, 0,
+                f"{prog}: f64 tensor in the compiled program (a numpy "
+                f"float64 leaked into the trace)", scope=prog))
+        if r["donate_expected"] and \
+                len(r["donated"]) < r["donate_expected"]:
+            findings.append(Finding(
+                "hlo-donation", "P1", file, 0,
+                f"{prog}: only {len(r['donated'])} of "
+                f"{r['donate_expected']} params/opt-state buffers "
+                f"donated — the fused step is double-buffering weights",
+                scope=prog))
+        cmax = bud.get("convert_max")
+        if cmax is not None and r["convert_count"] > cmax:
+            findings.append(Finding(
+                "hlo-convert-budget", "P1", file, 0,
+                f"{prog}: {r['convert_count']} convert ops, budget "
+                f"{cmax} (tools/analysis_baseline.json hlo_budgets)",
+                scope=prog))
+        rmax = bud.get("recompile_max")
+        if rmax is not None and r["recompiles"] > rmax:
+            findings.append(Finding(
+                "hlo-recompile-budget", "P1", file, 0,
+                f"{prog}: {r['recompiles']} compiled executables for "
+                f"one input shape, budget {rmax}", scope=prog))
+    return findings
+
+
+def amp_wire_findings(timeout=600):
+    """PR-4 invariant: the bf16 gradient all-reduce moves EXACTLY half
+    the wire bytes of fp32's. Two ``mxnet_tpu.amp --hlo-check``
+    subprocesses (each owns its backend: the post-SPMD dump flags are
+    read once at init)."""
+    recs = {}
+    for dt in ("float32", "bfloat16"):
+        proc = _sub(["mxnet_tpu.amp", "--hlo-check", "--dtype", dt],
+                    timeout)
+        recs[dt] = parse_last_metric(proc.stdout, "amp_hlo_check")
+        recs[dt].setdefault("_stderr", (proc.stderr or "")[-300:])
+    f32, b16 = recs["float32"], recs["bfloat16"]
+    if not f32.get("ok") or not b16.get("ok"):
+        bad = {d: r for d, r in recs.items() if not r.get("ok")}
+        return [Finding(
+            "hlo-amp-width", "P1", "amp/__init__.py", 0,
+            f"amp --hlo-check failed: {bad}", scope="amp_wire")]
+    fb = f32["grad_allreduce_bytes_per_step"]
+    bb = b16["grad_allreduce_bytes_per_step"]
+    if bb * 2 != fb:
+        return [Finding(
+            "hlo-amp-width", "P1", "amp/__init__.py", 0,
+            f"bf16 grad all-reduce moves {bb} wire bytes/step, want "
+            f"exactly half of fp32's {fb} — amp is not halving the "
+            f"collective", scope="amp_wire")]
+    return []
+
+
+def run(baseline=None, amp_wire=True, timeout=900):
+    """The full auditor: program matrix + (optionally) the amp wire
+    invariant. Returns findings; [] is a clean bill."""
+    findings = audit_findings(baseline, timeout=timeout)
+    if amp_wire:
+        findings += amp_wire_findings(timeout=timeout)
+    return findings
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis.hloaudit")
+    ap.add_argument("--audit-programs", action="store_true",
+                    help="subprocess body: compile the program matrix "
+                         "and print the hlo_audit JSON line")
+    args = ap.parse_args(argv)
+    if args.audit_programs:
+        return _audit_programs()
+    from . import load_baseline
+    findings = run(load_baseline())
+    for f in findings:
+        print(f)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

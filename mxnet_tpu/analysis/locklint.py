@@ -1,0 +1,678 @@
+"""locklint — concurrency audit over every Thread/Lock site.
+
+Builds, per module and then package-wide, (a) the set of shared-state
+surfaces (classes that spawn threads, hold locks, or are declared shared)
+and (b) the lock acquisition graph, then reports:
+
+  - ``lock-order-cycle`` (P0): two locks acquired in opposite orders on
+    different code paths (classic AB/BA deadlock), including one-level
+    call resolution — holding lock A while calling a function that takes
+    lock B creates an A→B edge, cross-module when the callee's name is
+    unambiguous in the package. Re-acquiring a non-reentrant ``Lock``
+    while already holding it is the 1-cycle special case.
+  - ``lock-inconsistent-guard`` (P1): the same attribute/global is
+    written under a lock on one path and bare on another — the lock is
+    load-bearing somewhere, so the bare write is a lost-update/torn-read
+    window.
+  - ``lock-unguarded-rmw`` (P1): a bare read-modify-write
+    (``self.n += 1``) on an attribute of a shared-state class. RMW is
+    never atomic across bytecode boundaries; two threads interleaving
+    drop increments silently.
+  - ``lock-cross-thread-write`` (P1): a bare plain write reachable from
+    a thread entry point of a class whose other methods run on callers'
+    threads.
+  - ``lock-unguarded-shared-write`` (P2, or P1 when the class is listed
+    in ``__analysis_shared__``): a bare plain write on a shared-state
+    surface — advisory because single-writer patterns are common and
+    benign.
+
+Annotation tables (module level, consumed by this pass):
+
+  ``__analysis_thread_safe__ = {"Class.attr", "global_name"}``
+      reviewed lock-free-by-design surfaces (e.g. GIL-atomic beat
+      counters); matching findings are dropped.
+  ``__analysis_shared__ = {"Class"}``
+      classes whose instances are shared across threads even though the
+      class itself spawns none and holds no lock; upgrades their bare
+      writes to P1.
+
+``__init__`` writes are exempt (the object is not yet published), as is
+any code while a lock — even an unresolvable one — is held, and any
+method that calls ``.acquire()`` manually (treated as locked
+throughout rather than guessed at).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+from .tracelint import _dotted, _apply_inline_allows, _dedupe
+
+__all__ = ["scan_tree", "scan_modules", "parse_module"]
+
+_LOCK_TYPES = {"Lock": "lock", "RLock": "rlock", "Condition": "rlock",
+               "Semaphore": "lock", "BoundedSemaphore": "lock"}
+# types whose own API is documented thread-safe: mutation through them
+# is not a finding
+_SAFE_TYPES = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+               "PriorityQueue", "deque", "Barrier", "local"}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "add",
+             "discard", "remove", "pop", "popitem", "clear"}
+_UNKNOWN = "<unknown-lock>"
+
+
+def _const_set(node):
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+class _Write:
+    __slots__ = ("owner", "attr", "line", "locked", "method", "rmw",
+                 "in_init")
+
+    def __init__(self, owner, attr, line, locked, method, rmw, in_init):
+        self.owner = owner      # class name, or None for module global
+        self.attr = attr
+        self.line = line
+        self.locked = locked
+        self.method = method    # method/function simple name
+        self.rmw = rmw
+        self.in_init = in_init
+
+
+class _Fn:
+    __slots__ = ("name", "qualname", "cls", "acquires", "calls",
+                 "manual_lock")
+
+    def __init__(self, name, qualname, cls):
+        self.name = name
+        self.qualname = qualname
+        self.cls = cls          # class name or None
+        self.acquires = []      # (lock_id, line, tuple(held_real))
+        self.calls = []         # (kind, callee, line, tuple(held_real))
+        self.manual_lock = False
+
+
+class _Class:
+    __slots__ = ("name", "lock_attrs", "safe_attrs", "thread_targets",
+                 "methods")
+
+    def __init__(self, name):
+        self.name = name
+        self.lock_attrs = {}     # attr -> "lock" | "rlock"
+        self.safe_attrs = set()
+        self.thread_targets = set()   # method names run on spawned threads
+        self.methods = {}        # name -> _Fn
+
+
+class _ModuleInfo:
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.thread_safe = set()
+        self.shared = set()
+        self.module_locks = {}   # global name -> "lock" | "rlock"
+        self.spawns_threads = False
+        self.classes = {}        # name -> _Class
+        self.fns = []            # every _Fn incl. methods + nested defs
+        self.writes = []         # every _Write
+        self.source_lines = []
+        self.import_aliases = set()   # module aliases usable as call roots
+
+
+def _creation_type(mod_imports, value):
+    """'lock'/'rlock'/'safe'/None for `threading.Lock()`-style calls."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func)
+    if not name:
+        return None
+    last = name.split(".")[-1]
+    if last in _LOCK_TYPES:
+        return _LOCK_TYPES[last]
+    if last in _SAFE_TYPES:
+        return "safe"
+    return None
+
+
+def _thread_target(call):
+    """The `target=` expr of a threading.Thread(...) call, else None."""
+    name = _dotted(call.func) or ""
+    if name.split(".")[-1] != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    if call.args:
+        return call.args[1] if len(call.args) > 1 else None
+    return None
+
+
+def parse_module(source, relpath):
+    """Build the per-module model: classes, locks, threads, writes,
+    acquisition records."""
+    info = _ModuleInfo(relpath)
+    info.source_lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return info
+    mod_imports = {}
+
+    # -- module-level declarations -------------------------------------------
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                info.import_aliases.add(al.asname or
+                                        al.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for al in node.names:
+                info.import_aliases.add(al.asname or al.name)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if tgt == "__analysis_thread_safe__":
+                info.thread_safe = _const_set(node.value)
+            elif tgt == "__analysis_shared__":
+                info.shared = _const_set(node.value)
+            else:
+                kind = _creation_type(mod_imports, node.value)
+                if kind in ("lock", "rlock"):
+                    info.module_locks[tgt] = kind
+
+    module_globals = {t.id for n in tree.body
+                      if isinstance(n, (ast.Assign, ast.AnnAssign))
+                      for t in (n.targets if isinstance(n, ast.Assign)
+                                else [n.target])
+                      if isinstance(t, ast.Name)}
+
+    # -- per-function walk ---------------------------------------------------
+
+    def lock_id(expr, cls):
+        """Lock identity for a with-item / acquire target, or None
+        (not a lock) / _UNKNOWN (a lock we can't name)."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            if cls is not None and expr.attr in cls.lock_attrs:
+                return f"{relpath}::{cls.name}.{expr.attr}"
+            low = expr.attr.lower()
+            if any(k in low for k in ("lock", "cond", "mutex", "sem")):
+                return _UNKNOWN
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in info.module_locks:
+                return f"{relpath}::{expr.id}"
+            low = expr.id.lower()
+            if any(k in low for k in ("lock", "cond", "mutex", "sem")):
+                return _UNKNOWN
+            return None
+        return None
+
+    def record_write(fn, cls, tgt, line, held, rmw, locals_):
+        locked = bool(held) or fn.manual_lock
+        in_init = fn.name == "__init__"
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+                and cls is not None:
+            info.writes.append(_Write(cls.name, tgt.attr, line, locked,
+                                      fn.name, rmw, in_init))
+            return
+        root = tgt
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in module_globals and \
+                root.id not in locals_ and root is not tgt:
+            # subscript/attr write through a module-level container
+            info.writes.append(_Write(None, root.id, line, locked,
+                                      fn.name, rmw, False))
+
+    def visit(node, fn, cls, held, locals_, declared_globals):
+        """Single-visit recursive walk threading the held-locks context."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs inside a method close over `self`: keep the
+            # class context so their self.X writes are still attributed
+            sub = _Fn(node.name, f"{fn.qualname}.{node.name}",
+                      cls.name if cls is not None else None)
+            info.fns.append(sub)
+            sub_locals = {a.arg for a in node.args.args}
+            sub_globals = set()
+            for st in node.body:
+                visit(st, sub, cls, [], sub_locals, sub_globals)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                visit(item.context_expr, fn, cls, new_held, locals_,
+                      declared_globals)
+                lid = lock_id(item.context_expr, cls)
+                if lid is not None:
+                    real = tuple(h for h in new_held if h != _UNKNOWN)
+                    if lid != _UNKNOWN:
+                        fn.acquires.append((lid, item.context_expr.lineno,
+                                            real))
+                    new_held.append(lid)
+                if item.optional_vars is not None:
+                    for t in ast.walk(item.optional_vars):
+                        if isinstance(t, ast.Name):
+                            locals_.add(t.id)
+            for st in node.body:
+                visit(st, fn, cls, new_held, locals_, declared_globals)
+            return
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared_globals.update(node.names)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for el in ([tgt] if not isinstance(tgt, ast.Tuple)
+                           else tgt.elts):
+                    if isinstance(el, (ast.Attribute, ast.Subscript)):
+                        record_write(fn, cls, el, el.lineno, held, False,
+                                     locals_)
+                    elif isinstance(el, ast.Name):
+                        if el.id in declared_globals:
+                            info.writes.append(_Write(
+                                None, el.id, el.lineno,
+                                bool(held) or fn.manual_lock, fn.name,
+                                False, False))
+                        else:
+                            locals_.add(el.id)
+        elif isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                record_write(fn, cls, tgt, tgt.lineno, held, True, locals_)
+            elif isinstance(tgt, ast.Name) and tgt.id in declared_globals:
+                info.writes.append(_Write(None, tgt.id, tgt.lineno,
+                                          bool(held) or fn.manual_lock,
+                                          fn.name, True, False))
+        elif isinstance(node, ast.Call):
+            _note_call(node, fn, cls, held)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    locals_.add(t.id)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    locals_.add(t.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn, cls, held, locals_, declared_globals)
+
+    def _note_call(call, fn, cls, held):
+        real = tuple(h for h in held if h != _UNKNOWN)
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "acquire":
+                lid = lock_id(func.value, cls)
+                if lid not in (None,):
+                    fn.manual_lock = True
+                    if lid != _UNKNOWN:
+                        fn.acquires.append((lid, call.lineno, real))
+                return
+            if func.attr in _MUTATORS and isinstance(func.value,
+                                                     ast.Attribute) and \
+                    isinstance(func.value.value, ast.Name) and \
+                    func.value.value.id == "self" and cls is not None:
+                # self.X.append(...) — container mutation counts as a write
+                info.writes.append(_Write(
+                    cls.name, func.value.attr, call.lineno,
+                    bool(held) or fn.manual_lock, fn.name, True,
+                    fn.name == "__init__"))
+            if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                    and cls is not None:
+                fn.calls.append(("self", func.attr, call.lineno, real))
+            else:
+                # only module-qualified calls (registry.counter(...)) can
+                # resolve cross-module; obj.method() on arbitrary objects
+                # (dicts, arrays) must NOT match functions by simple name
+                name = _dotted(func)
+                if name and name.split(".")[0] in info.import_aliases:
+                    fn.calls.append(("dotted", name, call.lineno, real))
+        elif isinstance(func, ast.Name):
+            fn.calls.append(("name", func.id, call.lineno, real))
+        tgt = _thread_target(call)
+        if tgt is not None:
+            _note_thread(tgt, cls)
+
+    def _note_thread(tgt, cls):
+        info.spawns_threads = True
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+                and cls is not None:
+            cls.thread_targets.add(tgt.attr)
+        elif isinstance(tgt, ast.Name):
+            for c in info.classes.values():
+                if tgt.id in c.methods:
+                    c.thread_targets.add(tgt.id)
+
+    # -- two passes: structure first (lock attrs need __init__), then walks --
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = _Class(node.name)
+            info.classes[node.name] = cls
+            for st in node.body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _Fn(st.name, f"{node.name}.{st.name}", node.name)
+                    cls.methods[st.name] = fn
+                    info.fns.append(fn)
+                    if st.name == "__init__":
+                        for sub in ast.walk(st):
+                            if isinstance(sub, ast.Assign):
+                                kind = _creation_type(mod_imports, sub.value)
+                                for t in sub.targets:
+                                    if kind and isinstance(t, ast.Attribute) \
+                                            and isinstance(t.value, ast.Name) \
+                                            and t.value.id == "self":
+                                        if kind == "safe":
+                                            cls.safe_attrs.add(t.attr)
+                                        else:
+                                            cls.lock_attrs[t.attr] = kind
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = info.classes[node.name]
+            for st in node.body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = cls.methods[st.name]
+                    fn_locals = {a.arg for a in st.args.args}
+                    fn_globals = set()
+                    for inner in st.body:
+                        visit(inner, fn, cls, [], fn_locals, fn_globals)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = _Fn(node.name, node.name, None)
+            info.fns.append(fn)
+            fn_locals = {a.arg for a in node.args.args}
+            fn_globals = set()
+            for inner in node.body:
+                visit(inner, fn, None, [], fn_locals, fn_globals)
+    return info
+
+
+# -- shared-state rules ------------------------------------------------------
+
+def _locked_context_methods(cls):
+    """Private methods of `cls` whose EVERY intra-class call site runs
+    with a lock held — directly (`with self._lock: self._run(...)`) or
+    transitively from another locked-context caller. Writes inside them
+    are lock-protected by contract; public methods and thread entry
+    points never qualify (they can be entered bare from anywhere)."""
+    callers = {}
+    for mname, fn in cls.methods.items():
+        for kind, callee, _line, held in fn.calls:
+            if kind == "self" and callee in cls.methods:
+                callers.setdefault(callee, []).append((mname, bool(held)))
+    cand = {m for m in cls.methods
+            if m.startswith("_") and not m.startswith("__")
+            and callers.get(m)}
+    cand -= set(cls.thread_targets)
+    changed = True
+    while changed:
+        changed = False
+        for m in list(cand):
+            if not all(h or c in cand for c, h in callers[m]):
+                cand.discard(m)
+                changed = True
+    return cand
+
+
+def _thread_reachable(cls):
+    """Method names reachable from the class's thread entry points via
+    self.m() calls (fixed point)."""
+    reach = set(cls.thread_targets)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(reach):
+            fn = cls.methods.get(name)
+            if fn is None:
+                continue
+            for kind, callee, _line, _held in fn.calls:
+                if kind == "self" and callee in cls.methods and \
+                        callee not in reach:
+                    reach.add(callee)
+                    changed = True
+    return reach
+
+
+def _shared_state_findings(info):
+    findings = []
+    locked_ctx = {name: _locked_context_methods(cls)
+                  for name, cls in info.classes.items()}
+    by_target = {}
+    for w in info.writes:
+        if w.owner is not None and w.method in locked_ctx.get(w.owner,
+                                                              ()):
+            w.locked = True
+        by_target.setdefault((w.owner, w.attr), []).append(w)
+
+    for (owner, attr), writes in sorted(by_target.items(),
+                                        key=lambda kv: (kv[0][0] or "",
+                                                        kv[0][1])):
+        if owner is not None:
+            cls = info.classes.get(owner)
+            if cls is None or attr in cls.lock_attrs or \
+                    attr in cls.safe_attrs:
+                continue
+            shared = bool(cls.thread_targets) or bool(cls.lock_attrs) or \
+                owner in info.shared
+            ann = f"{owner}.{attr}"
+            reach = _thread_reachable(cls)
+        else:
+            shared = info.spawns_threads or bool(info.module_locks)
+            ann = attr
+            reach = set()
+        if ann in info.thread_safe:
+            continue
+        locked = [w for w in writes if w.locked]
+        bare = [w for w in writes if not w.locked and not w.in_init]
+        if not bare:
+            continue
+        scope_of = (lambda w: f"{owner}.{w.method}" if owner
+                    else w.method)
+        if locked:
+            for w in bare:
+                findings.append(Finding(
+                    "lock-inconsistent-guard", "P1", info.relpath, w.line,
+                    f"{ann} is written under a lock elsewhere (e.g. "
+                    f"{scope_of(locked[0])}:{locked[0].line}) but bare "
+                    f"here — lost-update/torn-read window",
+                    scope=scope_of(w)))
+            continue
+        if not shared:
+            continue
+        for w in bare:
+            if w.rmw:
+                findings.append(Finding(
+                    "lock-unguarded-rmw", "P1", info.relpath, w.line,
+                    f"read-modify-write of {ann} without a lock on a "
+                    "shared-state surface — concurrent updates are lost",
+                    scope=scope_of(w)))
+            elif owner is not None and w.method in reach and \
+                    len(cls.methods) > len(reach):
+                findings.append(Finding(
+                    "lock-cross-thread-write", "P1", info.relpath, w.line,
+                    f"{ann} written bare from thread-entry-reachable "
+                    f"{w.method}() while other methods run on caller "
+                    "threads",
+                    scope=scope_of(w)))
+            else:
+                sev = "P1" if owner in info.shared else "P2"
+                findings.append(Finding(
+                    "lock-unguarded-shared-write", sev, info.relpath,
+                    w.line,
+                    f"bare write to {ann} on a shared-state surface "
+                    "(advisory: verify single-writer or take the lock)",
+                    scope=scope_of(w)))
+    return findings
+
+
+# -- lock-order rules --------------------------------------------------------
+
+def _lock_types(modules):
+    types = {}
+    for m in modules:
+        for name, kind in m.module_locks.items():
+            types[f"{m.relpath}::{name}"] = kind
+        for cname, cls in m.classes.items():
+            for attr, kind in cls.lock_attrs.items():
+                types[f"{m.relpath}::{cname}.{attr}"] = kind
+    return types
+
+
+def _lock_order_findings(modules):
+    types = _lock_types(modules)
+    # name -> [fn] for one-level cross-module call resolution (only
+    # unambiguous names contribute edges)
+    acquirers = {}
+    own_fns = {}
+    for m in modules:
+        for fn in m.fns:
+            if fn.acquires:
+                acquirers.setdefault(fn.name, []).append(fn)
+                if fn.cls is None:
+                    own_fns.setdefault(m.relpath, {})[fn.name] = fn
+
+    edges = {}          # (u, v) -> (relpath, line, via)
+    findings = []
+
+    def add_edge(u, v, relpath, line, via):
+        if u == v:
+            if types.get(u) == "lock":
+                findings.append(Finding(
+                    "lock-order-cycle", "P0", relpath, line,
+                    f"non-reentrant {u.split('::')[-1]} re-acquired while "
+                    f"already held ({via}) — self-deadlock",
+                    scope=u.split("::")[-1]))
+            return
+        edges.setdefault((u, v), (relpath, line, via))
+
+    for m in modules:
+        for fn in m.fns:
+            for lid, line, held in fn.acquires:
+                for h in held:
+                    add_edge(h, lid, m.relpath, line,
+                             f"nested acquire in {fn.qualname}")
+            for kind, callee, line, held in fn.calls:
+                if not held:
+                    continue
+                cands = []
+                if kind == "self" and fn.cls is not None:
+                    target = m.classes[fn.cls].methods.get(callee)
+                    if target is not None and target.acquires:
+                        cands = [target]
+                elif kind == "name":
+                    # same-module function first, else unique package-wide
+                    local = own_fns.get(m.relpath, {}).get(callee)
+                    if local is not None:
+                        cands = [local]
+                    else:
+                        cands = acquirers.get(callee, [])
+                        if len(cands) != 1:
+                            continue
+                else:
+                    simple = callee.split(".")[-1]
+                    cands = acquirers.get(simple, [])
+                    if len(cands) != 1:
+                        continue
+                for target in cands:
+                    for h2 in held:
+                        for lid, _l, _h in target.acquires:
+                            add_edge(h2, lid, m.relpath, line,
+                                     f"{fn.qualname} calls "
+                                     f"{target.qualname} while holding")
+
+    # Tarjan SCC over the edge set
+    graph = {}
+    for (u, v) in edges:
+        graph.setdefault(u, set()).add(v)
+        graph.setdefault(v, set())
+    index, low, on_stack, stack = {}, {}, set(), []
+    sccs, counter = [], [0]
+
+    def strongconnect(n):
+        work = [(n, iter(sorted(graph[n])))]
+        index[n] = low[n] = counter[0]
+        counter[0] += 1
+        stack.append(n)
+        on_stack.add(n)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for n in sorted(graph):
+        if n not in index:
+            strongconnect(n)
+
+    for scc in sccs:
+        members = set(scc)
+        site = None
+        for (u, v), s in sorted(edges.items()):
+            if u in members and v in members:
+                site = s
+                break
+        relpath, line, via = site if site else ("", 0, "")
+        pretty = " ↔ ".join(l.split("::")[-1] for l in scc)
+        findings.append(Finding(
+            "lock-order-cycle", "P0", relpath, line,
+            f"lock-order cycle {pretty}: acquired in conflicting orders "
+            f"on different paths ({via}) — potential deadlock",
+            scope="|".join(sorted(l.split("::")[-1] for l in scc))))
+    return findings
+
+
+# -- entry points ------------------------------------------------------------
+
+def scan_modules(sources):
+    """sources: iterable of (source_text, relpath). Returns findings."""
+    modules = [parse_module(src, rel) for src, rel in sources]
+    findings = []
+    for m in modules:
+        mf = _shared_state_findings(m)
+        findings.extend(_apply_inline_allows(mf, m.source_lines))
+    findings.extend(_lock_order_findings(modules))
+    return _dedupe(findings)
+
+
+def scan_tree(root):
+    sources = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    sources.append((f.read(), os.path.relpath(path, root)))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return scan_modules(sources)

@@ -1,0 +1,493 @@
+"""tracelint — AST passes that flag trace-impurity hazards.
+
+A function is *traced* when jax re-executes it symbolically: passed to
+``jax.jit`` / ``lax.scan`` / ``shard_map`` / ``vmap`` / ``grad`` (or
+decorated with one), defined inside such a function, or called by one
+(resolved lexically within the module, including ``self.method`` calls).
+Inside traced code, host-side effects are bugs of three shapes:
+
+  - **host syncs on traced values** (``trace-item-sync``,
+    ``trace-host-cast``, ``trace-np-asarray``): ``.item()``,
+    ``float()/int()/bool()`` or ``np.asarray`` applied to a value that
+    flows from the traced function's inputs forces a device sync at
+    trace time — and under ``lax.scan`` raises a TracerError or, worse,
+    silently bakes iteration-0's value into every step;
+  - **wall-clock / host RNG** (``trace-wallclock``, ``trace-host-rng``):
+    ``time.time()`` or ``np.random.*`` inside a traced function runs
+    ONCE at trace time, so the "random"/"current" value is a compile-time
+    constant replayed on every call — the classic silent-staleness bug;
+  - **Python-side state mutation** (``trace-state-mutation``): writes to
+    ``self.*``, closure or global state from a traced function happen at
+    trace time, not per step — counters silently freeze after the first
+    compile, caches corrupt under retrace.
+
+All rules are P1. Idiomatic escapes: keep the effect outside the traced
+function (the repo's ``float(loss)`` after ``step()`` pattern), or
+annotate a reviewed intentional site with a trailing
+``# analysis: allow=<rule>`` comment.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+
+__all__ = ["scan_file", "scan_tree", "scan_source"]
+
+# call/decorator names whose function-valued arguments are traced
+_TRACE_ENTRY = {
+    "jax.jit", "jit",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch",
+    "jax.lax.map", "lax.map",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian",
+    "jax.vjp", "jax.jvp", "jax.linearize",
+    "jax.checkpoint", "jax.remat",
+    "jax.custom_vjp", "custom_vjp", "jax.custom_jvp", "custom_jvp",
+    "shard_map", "_shard_map", "jax.experimental.shard_map.shard_map",
+}
+# method names whose args are traced regardless of the object (custom_vjp
+# fwd/bwd registration, custom_jvp defjvp)
+_TRACE_ENTRY_METHODS = {"defvjp", "defjvp"}
+
+_WALLCLOCK = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+    "time.monotonic_ns", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "datetime.date.today",
+}
+_HOST_RNG_PREFIXES = ("random.", "numpy.random.")
+_NP_SYNC = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "add",
+             "discard", "remove", "pop", "popitem", "clear", "write"}
+
+
+def _dotted(node):
+    """'jax.lax.scan' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Func:
+    __slots__ = ("node", "scope", "qualname", "traced", "params")
+
+    def __init__(self, node, scope, qualname):
+        self.node = node
+        self.scope = scope
+        self.qualname = qualname
+        self.traced = False
+        self.params = _param_names(node)
+
+
+def _param_names(node):
+    if isinstance(node, ast.Lambda):
+        a = node.args
+    else:
+        a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _Scope:
+    """Lexical scope: module, class or function. Holds the functions
+    defined directly in it, for name resolution."""
+
+    __slots__ = ("kind", "name", "parent", "functions", "cls")
+
+    def __init__(self, kind, name, parent):
+        self.kind = kind            # "module" | "class" | "function"
+        self.name = name
+        self.parent = parent
+        self.functions = {}         # local name -> _Func
+        self.cls = None             # nearest enclosing class scope
+
+    def resolve(self, name):
+        s = self
+        while s is not None:
+            # python name lookup never consults class scope from a nested
+            # function — methods are only reachable via self.X
+            if s.kind != "class" or s is self:
+                fn = s.functions.get(name)
+                if fn is not None:
+                    return fn
+            s = s.parent
+        return None
+
+
+class _Module:
+    """One parsed file: function registry, import table, trace roots."""
+
+    def __init__(self, tree, relpath):
+        self.relpath = relpath
+        self.funcs = {}             # id(node) -> _Func
+        self.imports = {}           # local alias -> canonical module path
+        self.scope_of = {}          # id(node) -> enclosing _Scope
+        self._build(tree, _Scope("module", "", None))
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self, node, scope):
+        for child in ast.iter_child_nodes(node):
+            self.scope_of[id(child)] = scope
+            if isinstance(child, ast.Import):
+                for al in child.names:
+                    self.imports[al.asname or
+                                 al.name.split(".")[0]] = \
+                        al.name if al.asname else al.name.split(".")[0]
+            elif isinstance(child, ast.ImportFrom):
+                if child.module and not child.level:
+                    for al in child.names:
+                        self.imports[al.asname or al.name] = \
+                            f"{child.module}.{al.name}"
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = (f"{scope.name}.{child.name}" if scope.name
+                      else child.name)
+                fn = _Func(child, scope, qn)
+                self.funcs[id(child)] = fn
+                scope.functions[child.name] = fn
+                sub = _Scope("function", qn, scope)
+                sub.cls = scope.cls
+                self._build(child, sub)
+            elif isinstance(child, ast.ClassDef):
+                sub = _Scope("class", (f"{scope.name}.{child.name}"
+                                       if scope.name else child.name),
+                             scope)
+                sub.cls = sub
+                self._build(child, sub)
+            elif isinstance(child, ast.Lambda):
+                qn = f"{scope.name}.<lambda>" if scope.name else "<lambda>"
+                self.funcs[id(child)] = _Func(child, scope, qn)
+                sub = _Scope("function", qn, scope)
+                sub.cls = scope.cls
+                self._build(child, sub)
+            else:
+                self._build(child, scope)
+
+    # -- canonical names -----------------------------------------------------
+
+    def canonical(self, node):
+        """Dotted call name with the import table applied to the root:
+        np.random.normal -> numpy.random.normal."""
+        name = _dotted(node)
+        if not name:
+            return None
+        root, _, rest = name.partition(".")
+        base = self.imports.get(root)
+        if base is None:
+            return name
+        return f"{base}.{rest}" if rest else base
+
+    # -- trace roots ---------------------------------------------------------
+
+    def _mark(self, value, scope, out):
+        """Mark a function-valued expression as traced."""
+        if isinstance(value, ast.Lambda):
+            fn = self.funcs.get(id(value))
+            if fn is not None:
+                out.add(fn)
+        elif isinstance(value, ast.Name):
+            fn = scope.resolve(value.id)
+            if fn is not None:
+                out.add(fn)
+        elif isinstance(value, ast.Attribute) and \
+                isinstance(value.value, ast.Name) and \
+                value.value.id == "self" and scope.cls is not None:
+            fn = scope.cls.functions.get(value.attr)
+            if fn is not None:
+                out.add(fn)
+        elif isinstance(value, ast.Call):
+            # jax.jit(jax.value_and_grad(f)): recurse into the inner call
+            # args when the inner call is itself a trace entry; otherwise
+            # (partial(f, x)) mark its first function-ish arg
+            inner = self.canonical(value.func)
+            if inner in _TRACE_ENTRY or (inner or "").split(".")[-1] == \
+                    "partial":
+                for a in list(value.args) + [k.value for k in
+                                             value.keywords]:
+                    self._mark(a, scope, out)
+
+    def trace_roots(self, tree):
+        roots = set()
+        scope_of = self.scope_of
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self.funcs.get(id(node))
+                for dec in node.decorator_list:
+                    name = self.canonical(dec.func if isinstance(
+                        dec, ast.Call) else dec)
+                    if name in _TRACE_ENTRY:
+                        roots.add(fn)
+                    elif isinstance(dec, ast.Call) and \
+                            (name or "").split(".")[-1] == "partial" and \
+                            dec.args and \
+                            self.canonical(dec.args[0]) in _TRACE_ENTRY:
+                        roots.add(fn)
+            elif isinstance(node, ast.Call):
+                name = self.canonical(node.func)
+                is_entry = name in _TRACE_ENTRY
+                if not is_entry and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _TRACE_ENTRY_METHODS:
+                    is_entry = True
+                if is_entry:
+                    scope = scope_of.get(id(node))
+                    if scope is None:
+                        continue
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        self._mark(a, scope, roots)
+        roots.discard(None)
+        return roots
+
+def _iter_own_nodes(func_node):
+    """Walk a function body, NOT descending into nested function/lambda
+    bodies (those are traced functions in their own right)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_names(func):
+    """Names bound inside the function (params + any Store), i.e. NOT
+    closure/global state."""
+    names = set(func.params)
+    for node in _iter_own_nodes(func.node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _traced_value_names(func):
+    """Names carrying traced values: the params, plus anything assigned
+    from an expression that mentions one (two propagation passes cover
+    the chains that occur in practice)."""
+    traced = set(func.params)
+    body = getattr(func.node, "body", None)
+    if body is None:
+        return traced
+    for _ in range(2):
+        for node in _iter_own_nodes(func.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            uses = any(isinstance(n, ast.Name) and n.id in traced
+                       for n in ast.walk(node.value))
+            if not uses:
+                continue
+            for tgt in node.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        traced.add(t.id)
+    return traced
+
+
+def _mentions(node, names):
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def _root_name(node):
+    """Leftmost Name of an Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _check_traced_function(mod, func, findings):
+    traced_names = _traced_value_names(func)
+    local_names = _local_names(func)
+    declared = set()        # global/nonlocal names
+    for node in _iter_own_nodes(func.node):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared.update(node.names)
+
+    def emit(rule, node, msg):
+        findings.append(Finding(rule, "P1", mod.relpath,
+                                getattr(node, "lineno", 0), msg,
+                                scope=func.qualname))
+
+    for node in _iter_own_nodes(func.node):
+        if isinstance(node, ast.Call):
+            canon = mod.canonical(node.func)
+            # .item() on anything inside a traced region
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                emit("trace-item-sync", node,
+                     ".item() inside a traced function forces a host "
+                     "sync at trace time")
+            elif canon in _WALLCLOCK:
+                emit("trace-wallclock", node,
+                     f"{canon}() inside a traced function is evaluated "
+                     "once at trace time (stale constant thereafter)")
+            elif canon and canon.startswith(_HOST_RNG_PREFIXES):
+                emit("trace-host-rng", node,
+                     f"{canon}() inside a traced function draws ONE "
+                     "value at trace time, replayed every call — use "
+                     "jax.random with a threaded key")
+            elif canon in _NP_SYNC and node.args and \
+                    _mentions(node.args[0], traced_names):
+                emit("trace-np-asarray", node,
+                     f"{canon}(<traced value>) materializes a tracer on "
+                     "host (sync or TracerArrayConversionError)")
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in _HOST_CASTS and node.args and \
+                    _mentions(node.args[0], traced_names):
+                emit("trace-host-cast", node,
+                     f"{node.func.id}(<traced value>) inside a traced "
+                     "function is a host sync (TracerError under scan)")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                root = _root_name(node.func.value)
+                if root is not None and root not in local_names:
+                    emit("trace-state-mutation", node,
+                         f"{root}.{node.func.attr}(...) mutates "
+                         "closure/global state at trace time, not per "
+                         "step")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    root = _root_name(tgt)
+                    if root is None:
+                        continue
+                    if root in func.params or root not in local_names:
+                        emit("trace-state-mutation", tgt,
+                             f"write to {root}.{'...' if isinstance(tgt, ast.Attribute) else '[...]'} "
+                             "from a traced function runs at trace "
+                             "time only (state silently freezes after "
+                             "the first compile)")
+                elif isinstance(tgt, ast.Name) and tgt.id in declared:
+                    emit("trace-state-mutation", tgt,
+                         f"global/nonlocal write to {tgt.id!r} from a "
+                         "traced function runs at trace time only")
+
+
+# -- inline suppression ------------------------------------------------------
+
+def _allowed_rules(source_line):
+    """Rules named by a trailing `# analysis: allow=rule1,rule2`."""
+    marker = "# analysis: allow="
+    i = source_line.find(marker)
+    if i < 0:
+        return ()
+    return tuple(r.strip() for r in
+                 source_line[i + len(marker):].split(",") if r.strip())
+
+
+def _dedupe(findings):
+    seen, out = set(), []
+    for f in findings:
+        k = (f.rule, f.file, f.line, f.scope)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def _apply_inline_allows(findings, source_lines):
+    """Drop findings suppressed by `# analysis: allow=<rule>` on the
+    flagged line or the line above it (for lines too long to carry a
+    trailing comment)."""
+    out = []
+    for f in findings:
+        allowed = set()
+        for ln in (f.line, f.line - 1):
+            if 0 < ln <= len(source_lines):
+                allowed.update(_allowed_rules(source_lines[ln - 1]))
+        if f.rule in allowed:
+            continue
+        out.append(f)
+    return out
+
+
+# -- entry points ------------------------------------------------------------
+
+def scan_source(source, relpath="<source>"):
+    """Lint one source string; returns the finding list."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("syntax-error", "P1", relpath, e.lineno or 0,
+                        f"file does not parse: {e.msg}")]
+    mod = _Module(tree, relpath)
+    traced = mod.trace_roots(tree)
+    # propagate: nested defs of traced functions + functions they call
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in _iter_own_nodes(fn.node):
+                callee = None
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    callee = mod.funcs.get(id(node))
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name):
+                        callee = fn.scope.resolve(node.func.id)
+                    elif isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == "self" and \
+                            fn.scope.cls is not None:
+                        callee = fn.scope.cls.functions.get(
+                            node.func.attr)
+                if callee is not None and callee not in traced:
+                    traced.add(callee)
+                    changed = True
+    findings = []
+    for fn in sorted(traced, key=lambda f: f.node.lineno):
+        _check_traced_function(mod, fn, findings)
+    return _apply_inline_allows(_dedupe(findings), source.splitlines())
+
+
+def scan_file(path, root=None):
+    rel = os.path.relpath(path, root) if root else os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("io-error", "P2", rel, 0, f"unreadable: {e}")]
+    return scan_source(source, rel)
+
+
+def scan_tree(root):
+    """Lint every .py under `root` (skipping caches); findings carry
+    root-relative paths."""
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                findings.extend(scan_file(os.path.join(dirpath, fname),
+                                          root=root))
+    return findings

@@ -85,6 +85,11 @@ import time
 
 from .state import TrainingState
 
+# analysis/locklint: _prev_sigterm is only touched from the main thread
+# (install/remove_sigterm_hook are main-thread-only by the signal-module
+# contract; _on_sigterm runs AS the main thread's signal handler)
+__analysis_thread_safe__ = {"CheckpointManager._prev_sigterm"}
+
 _STEP_PREFIX = "step-"
 _STAGING_PREFIX = ".staging-"
 _MANIFEST = "MANIFEST.json"
@@ -264,6 +269,10 @@ class CheckpointManager:
         state.meta.setdefault("step", step)
         if self._writes_here():
             if blocking:
+                # drain any in-flight async commit first: two overlapping
+                # _commit calls (saver thread + this one) race on staging
+                # dirs and retention sweeps
+                self.wait()
                 t0 = time.perf_counter()
                 try:
                     self._commit(state, step, metric)
@@ -292,12 +301,19 @@ class CheckpointManager:
         try:
             self.wait()
         finally:
+            # _thread is handed off under _cond everywhere (_enqueue
+            # starts it under the lock); join OUTSIDE the lock — the
+            # saver loop takes _cond to finish, so joining while holding
+            # it would deadlock
             with self._cond:
                 self._closed = True
                 self._cond.notify_all()
-            if self._thread is not None:
-                self._thread.join(timeout=60)
-                self._thread = None
+                t = self._thread
+            if t is not None:
+                t.join(timeout=60)
+                with self._cond:
+                    if self._thread is t:
+                        self._thread = None
 
     def steps(self):
         """Committed step numbers visible to this process, ascending.
@@ -472,8 +488,12 @@ class CheckpointManager:
 
     def _write_file(self, path, payload):
         def _write():
-            if self._inject_io > 0:     # selftest/CI fault injection
-                self._inject_io -= 1
+            inject = False
+            with self._cond:
+                if self._inject_io > 0:  # selftest/CI fault injection
+                    self._inject_io -= 1
+                    inject = True
+            if inject:
                 raise OSError(f"injected I/O failure "
                               f"(MXNET_CHECKPOINT_INJECT_IO_FAIL): {path}")
             with open(path, "wb") as f:

@@ -106,6 +106,13 @@ _DOCUMENTED = {
     "MXNET_TELEMETRY_LOG": None,
     "MXNET_TELEMETRY_STALL_S": None,
     "MXNET_TELEMETRY_STALL_PATH": None,
+    # static analysis (mxnet_tpu.analysis, docs/ANALYSIS.md):
+    # MXNET_ANALYSIS_BASELINE=<path> points the finding-suppression
+    # baseline somewhere other than tools/analysis_baseline.json;
+    # MXNET_ANALYSIS_STRICT=1 makes `python -m mxnet_tpu.analysis`
+    # strict by default (exit non-zero on unsuppressed P0/P1)
+    "MXNET_ANALYSIS_BASELINE": None,
+    "MXNET_ANALYSIS_STRICT": 0,
 }
 
 

@@ -278,6 +278,8 @@ def _vmem_params(s, d, n_full_streams, interpret, itemsize=2):
         # q/out blocks + lse + scratch ride within the default budget
         return {}
     from jax.experimental.pallas import tpu as pltpu
+    # s/d/need are static python shape ints even at trace time, not
+    # tracers — the cast never syncs  # analysis: allow=trace-host-cast
     limit = min(110 * 2 ** 20, int(need * 1.5) + 16 * 2 ** 20)
     return {"compiler_params": pltpu.CompilerParams(
         vmem_limit_bytes=limit)}

@@ -1056,6 +1056,8 @@ register("_contrib_quadratic", _quadratic,
 def _rpn_base_anchors(feature_stride, ratios, scales):
     """py-faster-rcnn anchor table (proposal-inl.h GenerateAnchors :214,
     _Transform :196): ratios outer, scales inner; +1-width conventions."""
+    # feature_stride comes from the op's static attrs (python number),
+    # never a tracer  # analysis: allow=trace-host-cast
     fs = float(feature_stride)
     w = h = fs
     x_ctr = y_ctr = (fs - 1.0) / 2.0

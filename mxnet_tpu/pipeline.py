@@ -102,6 +102,14 @@ _END = "end"
 _ITEM = "item"
 _ERR = "err"
 
+# analysis/locklint: DeviceFeed's counters are single-writer by thread
+# discipline — stage_us is written ONLY by the feeder thread, wait_us/
+# batches/_done ONLY by the consumer thread (close() flips _done after
+# the feeder is joined); += with one writer is safe under the GIL and
+# readers (overlap_frac/stats) tolerate a one-item-stale value
+__analysis_thread_safe__ = {"DeviceFeed.stage_us", "DeviceFeed.wait_us",
+                            "DeviceFeed.batches", "DeviceFeed._done"}
+
 
 class DeviceFeed:
     """Iterate `source` with staging one batch ahead on a feeder thread.

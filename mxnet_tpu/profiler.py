@@ -31,6 +31,16 @@ __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
 _lock = threading.Lock()
 _state = "stop"
 _paused = False
+
+# analysis/locklint annotation tables:
+#  - Counter instances are handed to serving/telemetry code that ticks
+#    them from request threads — locklint holds their writes to the
+#    module _lock (see Counter.set_value/increment)
+#  - _state/_paused/_xplane_active are control-plane toggles flipped from
+#    the user's thread only (set_state/pause/resume are not request-path
+#    APIs); readers tolerate a stale boolean for one event
+__analysis_shared__ = {"Counter"}
+__analysis_thread_safe__ = {"_state", "_paused", "_xplane_active"}
 _events = []            # chrome trace events
 _agg = {}               # name -> [count, total_us, min_us, max_us]
 _config = {
@@ -311,23 +321,30 @@ class Counter:
             self.set_value(value)
 
     def set_value(self, value):
-        self.value = value
-        # gate on is_running() like spans do: long-lived counters
-        # (serving queue depth/shed) tick on every request, and recording
-        # while stopped/paused grew _events without bound on a server
-        # that never profiles
+        with _lock:
+            self.value = value
+            self._record(value)
+
+    def _record(self, value):
+        # call with _lock held. gate on is_running() like spans do:
+        # long-lived counters (serving queue depth/shed) tick on every
+        # request, and recording while stopped/paused grew _events
+        # without bound on a server that never profiles
         if not is_running():
             return
-        with _lock:
-            _events.append({"name": self.name, "ph": "C",
-                            "ts": time.perf_counter() * 1e6, "pid": 0,
-                            "args": {self.name: value}})
+        _events.append({"name": self.name, "ph": "C",
+                        "ts": time.perf_counter() * 1e6, "pid": 0,
+                        "args": {self.name: value}})
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        # read-modify-write under the lock: counters tick concurrently
+        # from serving request threads, and a bare += loses updates
+        with _lock:
+            self.value = self.value + delta
+            self._record(self.value)
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        self.increment(-delta)
 
 
 class Marker:

@@ -190,8 +190,12 @@ class ServingEngine:
             arrays = [np.concatenate(
                 [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)],
                 axis=0) for a in arrays]
-            self.padded_rows += bucket - n
         with self._lock:
+            # padding accounting under the lock: infer() runs concurrently
+            # on batcher-worker and direct-caller threads, and += on a
+            # bare attribute loses updates under that interleaving
+            if bucket != n:
+                self.padded_rows += bucket - n
             outs = self._run(bucket, arrays)
         return [np.asarray(o)[:n]
                 if getattr(o, "ndim", 0) and np.asarray(o).shape[0] == bucket

@@ -182,7 +182,8 @@ class StepLogger:
                - self._ckpt_last["ckpt_save_us"],
                "ckpt_wait_us": ckpt["ckpt_wait_us"]
                - self._ckpt_last["ckpt_wait_us"]}
-        self._ckpt_last = ckpt
+        with self._lock:
+            self._ckpt_last = ckpt
         if extra:
             rec.update(extra)
         self._emit(rec)
@@ -194,21 +195,25 @@ class StepLogger:
                     "wall_s": round(wall, 6),
                     "samples_per_s": round(self._samples / wall, 3)
                     if wall > 0 and self._samples else None, **extra})
-        if self._file is not None:
+        f = self._file
+        if f is not None:
             try:
-                self._file.close()
+                f.close()
             finally:
-                self._file = None
+                with self._lock:
+                    self._file = None
 
     def _emit(self, rec):
-        if self._file is None:
+        f = self._file
+        if f is None:
             return
         rec.setdefault("ts", round(time.time(), 3))
         try:
-            self._file.write(json.dumps(rec) + "\n")
-            self._file.flush()
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
         except (OSError, ValueError):   # disk full / closed file
-            self._file = None
+            with self._lock:
+                self._file = None
 
     def __enter__(self):
         return self

@@ -34,6 +34,12 @@ import time
 __all__ = ["install", "uninstall", "beat", "last_beat_age", "install_sigusr1",
            "dump_after", "cancel_deadline", "dump_now"]
 
+# analysis/locklint: beat()/_monitor write _state lock-free BY DESIGN —
+# the hot path is two GIL-atomic dict stores per training step, and the
+# monitor explicitly tolerates torn label/beat pairs (see beat's
+# docstring); install/uninstall serialize structural changes under _lock
+__analysis_thread_safe__ = {"_state"}
+
 _state = {
     "thread": None,          # monitor thread
     "stop": None,            # threading.Event

@@ -1,0 +1,721 @@
+"""mxnet_tpu.analysis — trace-purity lint, concurrency audit, HLO
+invariant auditor (ISSUE 9).
+
+Covers all three pass families with positive AND negative fixtures per
+rule, the finding/baseline plumbing, the CLI strict exit codes, plus
+regression tests for the concurrency bugs the audit's own first run
+surfaced (profiler Counter RMW, serving padded_rows accounting,
+checkpoint blocking-save overlap, steplog teardown).
+
+The acceptance fixtures the issue names are here and live:
+  - an injected `.item()` inside a scanned step fails strict
+    (test_tracelint_item_sync_in_scanned_step);
+  - an injected unlocked cross-thread write fails strict
+    (test_locklint_cross_thread_write_fails_strict);
+  - a broken-donation program fails strict
+    (test_hloaudit_broken_donation_fails_strict, against HLO text from
+    a REAL compile, not a synthetic string).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.analysis import (DEFAULT_HLO_BUDGETS, Finding, hlo_budget,
+                                load_baseline, package_root,
+                                save_baseline, strict_failures, suppress)
+from mxnet_tpu.analysis import hloaudit, locklint, tracelint
+
+
+def _src(text):
+    return textwrap.dedent(text)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# -- tracelint: one positive + one negative fixture per rule -----------------
+
+def test_tracelint_item_sync_in_scanned_step():
+    # ACCEPTANCE: injected .item() in a lax.scan body is caught and
+    # fails strict
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        def train(xs):
+            def step(carry, x):
+                loss = carry + x
+                host = loss.item()
+                return carry + host, loss
+            return jax.lax.scan(step, 0.0, xs)
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-item-sync"]
+    assert fs[0].severity == "P1" and fs[0].scope == "train.step"
+    assert strict_failures(fs), "an unsuppressed P1 must fail strict"
+
+
+def test_tracelint_item_outside_trace_is_clean():
+    fs = tracelint.scan_source(_src("""
+        def host_log(loss):
+            return loss.item()
+    """), "fixture.py")
+    assert fs == []
+
+
+def test_tracelint_host_cast_on_traced_value():
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-host-cast"]
+
+
+def test_tracelint_cast_of_static_constant_is_clean():
+    # float(3) mentions no traced name: static shape arithmetic is fine
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            scale = float(3) * 2.0
+            return x * scale
+    """), "fixture.py")
+    assert fs == []
+
+
+def test_tracelint_np_asarray_and_assignment_propagation():
+    # y flows from the param through an assignment; np.asarray(y) syncs
+    fs = tracelint.scan_source(_src("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            return np.asarray(y)
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-np-asarray"]
+
+
+def test_tracelint_wallclock_and_host_rng():
+    fs = tracelint.scan_source(_src("""
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            t = time.time()
+            noise = np.random.normal(size=4)
+            return x + noise + t
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-host-rng", "trace-wallclock"]
+
+
+def test_tracelint_jax_random_is_clean():
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x, key):
+            return x + jax.random.normal(key, x.shape)
+    """), "fixture.py")
+    assert fs == []
+
+
+def test_tracelint_state_mutation_self_and_closure():
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        class Model:
+            def build(self):
+                self._fn = jax.jit(self._step)
+
+            def _step(self, x):
+                self.calls += 1
+                return x * 2
+
+        def outer(xs):
+            seen = []
+
+            def body(carry, x):
+                seen.append(1)
+                return carry, x
+            return jax.lax.scan(body, 0.0, xs)
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-state-mutation", "trace-state-mutation"]
+    assert {f.scope for f in fs} == {"Model._step", "outer.body"}
+
+
+def test_tracelint_propagates_to_called_helper():
+    # g is never passed to jit directly — it is called BY a jitted fn
+    fs = tracelint.scan_source(_src("""
+        import time
+        import jax
+
+        def g(x):
+            return x + time.time()
+
+        @jax.jit
+        def f(x):
+            return g(x)
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-wallclock"]
+    assert fs[0].scope == "g"
+
+
+def test_tracelint_partial_jit_decorator():
+    fs = tracelint.scan_source(_src("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=(1,))
+        def f(x, n):
+            return float(x) * n
+    """), "fixture.py")
+    assert _rules(fs) == ["trace-host-cast"]
+
+
+def test_tracelint_inline_allow_suppresses():
+    fs = tracelint.scan_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            # reviewed: static python int  # analysis: allow=trace-host-cast
+            return float(x)
+    """), "fixture.py")
+    assert fs == []
+
+
+# -- locklint: one positive + one negative fixture per rule ------------------
+
+def test_locklint_lock_order_cycle_p0():
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def path1():
+            with a:
+                with b:
+                    pass
+
+        def path2():
+            with b:
+                with a:
+                    pass
+    """), "fixture.py")])
+    cycles = [f for f in fs if f.rule == "lock-order-cycle"]
+    assert cycles and all(f.severity == "P0" for f in cycles)
+    assert strict_failures(fs)
+
+
+def test_locklint_consistent_order_is_clean():
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def path1():
+            with a:
+                with b:
+                    pass
+
+        def path2():
+            with a:
+                with b:
+                    pass
+    """), "fixture.py")])
+    assert [f for f in fs if f.rule == "lock-order-cycle"] == []
+
+
+def test_locklint_self_deadlock_through_call_resolution():
+    # holding the non-reentrant Lock while calling a method that
+    # re-acquires it: the 1-cycle deadlock, found through the call edge
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.%s()
+                self.n = 0
+
+            def get(self):
+                with self._lock:
+                    return self.n
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+                    return self.get()
+    """
+    fs = locklint.scan_modules([(_src(src % "Lock"), "fixture.py")])
+    assert "lock-order-cycle" in _rules(fs)
+    fs_rlock = locklint.scan_modules([(_src(src % "RLock"), "fixture.py")])
+    assert "lock-order-cycle" not in _rules(fs_rlock)
+
+
+def test_locklint_inconsistent_guard():
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        class Stat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def add(self, v):
+                with self._lock:
+                    self.total = self.total + v
+
+            def reset(self):
+                self.total = 0
+    """), "fixture.py")])
+    assert "lock-inconsistent-guard" in _rules(fs)
+    assert all(f.severity == "P1" for f in fs
+               if f.rule == "lock-inconsistent-guard")
+
+
+def test_locklint_unguarded_rmw():
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.done = 0
+
+            def tick(self):
+                self.done += 1
+    """), "fixture.py")])
+    assert "lock-unguarded-rmw" in _rules(fs)
+
+
+def test_locklint_cross_thread_write_fails_strict():
+    # ACCEPTANCE: injected unlocked cross-thread write is caught and
+    # fails strict — _worker runs on the spawned thread, status is also
+    # visible to callers' threads via snapshot()
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        class Runner:
+            def __init__(self):
+                self.status = "idle"
+                self._t = threading.Thread(target=self._worker)
+                self._t.start()
+
+            def _worker(self):
+                self.status = "running"
+
+            def snapshot(self):
+                return self.status
+    """), "fixture.py")])
+    assert "lock-cross-thread-write" in _rules(fs)
+    assert strict_failures(fs)
+
+
+def test_locklint_guarded_class_is_clean():
+    fs = locklint.scan_modules([(_src("""
+        import threading
+
+        class Runner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.status = "idle"
+                self._t = threading.Thread(target=self._worker)
+                self._t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self.status = "running"
+
+            def snapshot(self):
+                with self._lock:
+                    return self.status
+    """), "fixture.py")])
+    assert fs == []
+
+
+def test_locklint_thread_safe_annotation_drops_finding():
+    base = """
+        import threading
+        %s
+
+        class Feed:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+                self.beats = 0
+
+            def _run(self):
+                self.beats = 1
+
+            def read(self):
+                return self.beats
+    """
+    flagged = locklint.scan_modules(
+        [(_src(base % ""), "fixture.py")])
+    assert "lock-cross-thread-write" in _rules(flagged)
+    declared = locklint.scan_modules(
+        [(_src(base % '__analysis_thread_safe__ = {"Feed.beats"}'),
+          "fixture.py")])
+    assert declared == []
+
+
+def test_locklint_shared_annotation_upgrades_to_p1():
+    # no lock, no thread spawn: only __analysis_shared__ makes this a
+    # shared surface, and it lands at P1 (not advisory P2)
+    fs = locklint.scan_modules([(_src("""
+        __analysis_shared__ = {"Counter"}
+
+        class Counter:
+            def __init__(self):
+                self.value = 0
+
+            def set_value(self, v):
+                self.value = v
+    """), "fixture.py")])
+    assert _rules(fs) == ["lock-unguarded-shared-write"]
+    assert fs[0].severity == "P1"
+
+
+# -- findings / baseline plumbing --------------------------------------------
+
+def test_finding_key_is_scope_stable():
+    f = Finding("r", "P1", "a/b.py", 42, "msg", scope="Cls.m")
+    g = Finding("r", "P1", "a/b.py", 99, "msg moved", scope="Cls.m")
+    assert f.key() == g.key() == "r::a/b.py::Cls.m"
+    assert f.to_dict()["key"] == f.key()
+
+
+def test_baseline_roundtrip_and_suppression(tmp_path):
+    p = str(tmp_path / "baseline.json")
+    f1 = Finding("rule-a", "P1", "m.py", 1, "x", scope="f")
+    f2 = Finding("rule-b", "P2", "m.py", 2, "y", scope="g")
+    save_baseline({"suppress": [f1.key()],
+                   "hlo_budgets": {"fit_step_bf16": {"convert_max": 9}}},
+                  p)
+    b = load_baseline(p)
+    active, suppressed = suppress([f1, f2], b)
+    assert [f.key() for f in suppressed] == [f1.key()]
+    assert [f.key() for f in active] == [f2.key()]
+    # P1 fails strict only unsuppressed; P2 never fails
+    assert strict_failures([f1, f2], b) == []
+    assert [f.key() for f in strict_failures([f1, f2])] == [f1.key()]
+    # budget override is key-by-key over the shipped defaults
+    bud = hlo_budget(b, "fit_step_bf16")
+    assert bud["convert_max"] == 9
+    assert bud["recompile_max"] == \
+        DEFAULT_HLO_BUDGETS["fit_step_bf16"]["recompile_max"]
+
+
+def test_load_baseline_missing_file_is_empty(tmp_path):
+    b = load_baseline(str(tmp_path / "nope.json"))
+    assert b == {"suppress": [], "hlo_budgets": {}}
+
+
+def test_cli_strict_exit_codes(tmp_path):
+    # a tree with one injected P1: strict fails, --write-baseline
+    # accepts it, strict then passes — the burn-down loop end to end
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "bad.py").write_text(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """))
+    bl = str(tmp_path / "baseline.json")
+    cmd = [sys.executable, "-m", "mxnet_tpu.analysis", "--skip-hlo",
+           "--root", str(root), "--baseline", bl]
+    strict = subprocess.run(cmd + ["--strict", "--json"],
+                            capture_output=True, text=True, timeout=120)
+    assert strict.returncode == 1, strict.stdout + strict.stderr
+    rec = json.loads(strict.stdout.strip().splitlines()[-1])
+    assert rec["strict_failures"] == 1 and not rec["ok"]
+    assert rec["findings"][0]["rule"] == "trace-host-cast"
+    # non-strict: report but exit 0
+    report = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=120)
+    assert report.returncode == 0
+    wb = subprocess.run(cmd + ["--write-baseline"], capture_output=True,
+                        text=True, timeout=120)
+    assert wb.returncode == 0
+    assert "trace-host-cast::bad.py::f" in \
+        load_baseline(bl)["suppress"]
+    again = subprocess.run(cmd + ["--strict"], capture_output=True,
+                           text=True, timeout=120)
+    assert again.returncode == 0, again.stdout + again.stderr
+
+
+def test_repo_is_clean_under_strict():
+    # the shipped contract: source passes over the real package find
+    # nothing the shipped baseline does not list — this is the
+    # regression test for every source-level fix this pass surfaced
+    # (serving padded_rows, profiler Counter, checkpoint manager,
+    # steplog): reintroducing any of them refails here
+    findings = tracelint.scan_tree(package_root()) + \
+        locklint.scan_tree(package_root())
+    baseline = load_baseline(os.path.join(os.path.dirname(
+        package_root()), "tools", "analysis_baseline.json"))
+    bad = strict_failures(findings, baseline)
+    assert bad == [], f"unsuppressed P0/P1 in the package: {bad}"
+    # the baseline carries accepted P2s only
+    active, suppressed = suppress(findings, baseline)
+    assert all(f.severity == "P2" for f in suppressed), suppressed
+
+
+# -- hloaudit: text helpers on synthetic and REAL HLO ------------------------
+
+_HLO_HEADER = ("HloModule jit_multi, is_scheduled=true, "
+               "input_output_alias={ {0}: (0, {}, may-alias), "
+               "{1}: (1, {}, may-alias), {2}: (3, {}, may-alias) }, "
+               "entry_computation_layout={(f32[4],f32[4])->f32[4]}\n")
+
+
+def test_donated_param_indices_synthetic():
+    assert hloaudit.donated_param_indices(_HLO_HEADER) == {0, 1, 3}
+    assert hloaudit.donated_param_indices("HloModule jit_f\n") == set()
+
+
+def test_allreduce_helpers():
+    hlo = ("a = f32[16] all-reduce(b), replica_groups={}\n"
+           "c = f32[16] all-reduce-start(d)\n"
+           "e = f32[16] all-reduce-done(c)\n")
+    assert hloaudit.allreduce_counts(hlo) == (1, 1)
+    assert hloaudit.allreduce_pairing_ok(hlo)
+    assert not hloaudit.allreduce_pairing_ok(
+        "c = f32[16] all-reduce-start(d)\n")
+    assert hloaudit.has_f64("x = f64[2] constant(0)")
+    assert not hloaudit.has_f64("x = f32[64] parameter(0)")
+    assert hloaudit.convert_count(
+        "a = bf16[4] convert(b)\nc = f32[4] convert(a)\n") == 2
+
+
+def test_wire_bytes():
+    assert hloaudit.wire_bytes([["f32", "16,8"], ["f32", "16"]]) == \
+        4 * (128 + 16)
+    assert hloaudit.wire_bytes([["bf16", "16,8"]]) == 2 * 128
+    assert hloaudit.wire_bytes([["f32", ""]]) == 4   # scalar
+
+
+def test_spmd_allreduces_parses_dump_dir(tmp_path):
+    f = tmp_path / ("module_0001.jit_step.42."
+                    "after_spmd-partitioning.txt")
+    f.write_text("  %ar = bf16[16,8]{1,0} all-reduce(%g), "
+                 "replica_groups={{0,1}}\n"
+                 "  %s = f32[] all-reduce(%l), replica_groups={{0,1}}\n")
+    (tmp_path / "module_0001.jit_step.42.before_optimizations.txt") \
+        .write_text("%x = f32[2,2] all-reduce(%y)\n")
+    ars = hloaudit.spmd_allreduces(str(tmp_path), "jit_step")
+    assert ars == [["bf16", "16,8"], ["f32", ""]]
+
+
+def test_parse_last_metric():
+    out = ("noise\n"
+           '{"metric": "other", "ok": false}\n'
+           '{"metric": "amp_hlo_check", "ok": true}\n')
+    assert hloaudit.parse_last_metric(out, "amp_hlo_check")["ok"]
+    assert hloaudit.parse_last_metric(out, "missing") == {}
+    assert hloaudit.parse_last_metric("", "x") == {}
+
+
+def _healthy_program():
+    return {"allreduce_sync": 5, "allreduce_async": 0, "pairing_ok": True,
+            "has_f64": False, "convert_count": 3,
+            "donated": list(range(8)), "donate_expected": 8,
+            "recompiles": 1}
+
+
+def test_findings_from_report_healthy_is_clean():
+    rec = {"metric": "hlo_audit",
+           "programs": {"fit_step_fp32": _healthy_program()}}
+    assert hloaudit.findings_from_report(rec) == []
+
+
+def test_hloaudit_broken_donation_fails_strict():
+    # ACCEPTANCE: a broken-donation program fails strict. The HLO comes
+    # from a REAL compile of the same shape the fused step uses
+    # (donate_argnums present vs absent), parsed by the same helper the
+    # auditor runs.
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return a + b, b * 2
+
+    x = jnp.zeros(16)
+    donated = jax.jit(f, donate_argnums=(0,)).lower(x, x) \
+        .compile().as_text()
+    broken = jax.jit(f).lower(x, x).compile().as_text()
+    assert 0 in hloaudit.donated_param_indices(donated)
+    assert hloaudit.donated_param_indices(broken) == set()
+
+    prog = _healthy_program()
+    prog["donated"] = sorted(hloaudit.donated_param_indices(broken))
+    rec = {"metric": "hlo_audit", "programs": {"fit_step_bf16": prog}}
+    fs = hloaudit.findings_from_report(rec)
+    assert _rules(fs) == ["hlo-donation"]
+    assert strict_failures(fs), "missing donation must fail strict"
+
+
+def test_findings_from_report_budgets_and_p0s():
+    prog = _healthy_program()
+    prog.update(convert_count=500, recompiles=3, allreduce_sync=0,
+                pairing_ok=False, has_f64=True)
+    rec = {"metric": "hlo_audit", "programs": {"fit_step_fp32": prog}}
+    fs = hloaudit.findings_from_report(rec)
+    assert _rules(fs) == ["hlo-allreduce-pairing", "hlo-convert-budget",
+                          "hlo-f64", "hlo-missing-allreduce",
+                          "hlo-recompile-budget"]
+    by_rule = {f.rule: f for f in fs}
+    assert by_rule["hlo-missing-allreduce"].severity == "P0"
+    assert by_rule["hlo-allreduce-pairing"].severity == "P0"
+    # baseline hlo_budgets lift the convert/recompile findings
+    lifted = hloaudit.findings_from_report(
+        rec, {"hlo_budgets": {"fit_step_fp32": {"convert_max": 600,
+                                                "recompile_max": 3}}})
+    assert _rules(lifted) == ["hlo-allreduce-pairing", "hlo-f64",
+                              "hlo-missing-allreduce"]
+
+
+@pytest.mark.slow
+def test_hloaudit_program_matrix_live():
+    # the full subprocess matrix against the real repo: clean bill
+    assert hloaudit.audit_findings(load_baseline()) == []
+
+
+def test_amp_wire_invariant_via_auditor():
+    # satellite: the PR-4 invariant — bf16 grad all-reduce moves exactly
+    # half the fp32 wire bytes — asserted through the auditor itself
+    assert hloaudit.amp_wire_findings() == []
+
+
+# -- regression tests for the bugs the audit's first run surfaced ------------
+
+def test_profiler_counter_increment_is_atomic():
+    # profiler.Counter.increment was a bare read-modify-write on a
+    # module-shared object; 8 threads x 200 increments now always lands
+    # on exactly 1600
+    from mxnet_tpu import profiler
+
+    c = profiler.Counter("analysis_test", "analysis_test_counter")
+    n_threads, n_inc = 8, 200
+
+    def spin():
+        for _ in range(n_inc):
+            c.increment(1)
+
+    ts = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == n_threads * n_inc
+
+
+def test_serving_padded_rows_accounting_concurrent():
+    # ServingEngine.infer accumulated padded_rows outside the lock;
+    # concurrent callers must not lose padding updates
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import ServingEngine
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="anfc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    args, auxs = mod.get_params()
+    eng = ServingEngine.from_symbol(sym, args, auxs, {"data": (8, 6)},
+                                    warmup=False)
+    x = np.zeros((3, 6), np.float32)      # bucket 4 -> 1 padded row
+    pad_per_call = eng.bucket_for(3) - 3
+    eng.infer(x)                          # compile outside the race
+    before = eng.padded_rows
+    n_threads, n_calls = 6, 5
+
+    def spin():
+        for _ in range(n_calls):
+            eng.infer(x)
+
+    ts = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert eng.padded_rows - before == \
+        n_threads * n_calls * pad_per_call
+
+
+def test_checkpoint_blocking_save_drains_inflight_async(tmp_path):
+    # a blocking save while an async commit is in flight used to run two
+    # _commit calls concurrently (staging-dir/retention races); it now
+    # drains the saver first
+    from mxnet_tpu.checkpoint import CheckpointManager, TrainingState
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    inner = mgr._commit
+    active, overlap = [0], [0]
+    gate = threading.Lock()
+
+    def slow_commit(state, step, metric):
+        with gate:
+            active[0] += 1
+            overlap[0] = max(overlap[0], active[0])
+        time.sleep(0.15)
+        try:
+            return inner(state, step, metric)
+        finally:
+            with gate:
+                active[0] -= 1
+
+    mgr._commit = slow_commit
+    try:
+        st = lambda s: TrainingState(
+            arrays={"param:w": np.float32([s])}, meta={"step": s})
+        mgr.save(st(1), 1, blocking=False)
+        mgr.save(st(2), 2, blocking=True)
+    finally:
+        mgr.close()
+    assert overlap[0] == 1, "blocking save overlapped the async commit"
+    assert mgr.steps() == [1, 2]
+
+
+def test_steplog_close_is_idempotent_and_race_safe(tmp_path, monkeypatch):
+    # close() used to tear _file down without the lock while _emit wrote
+    # on another thread; also step() after close must be a no-op
+    monkeypatch.setenv("MXNET_TELEMETRY_LOG",
+                       str(tmp_path / "steps.jsonl"))
+    from mxnet_tpu.telemetry import StepLogger
+
+    slog = StepLogger("analysis_test")
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            slog.step(samples=1)
+
+    t = threading.Thread(target=spin)
+    t.start()
+    time.sleep(0.05)
+    slog.close()
+    slog.close()
+    stop.set()
+    t.join()
+    slog.step(samples=1)      # after close: no crash, no resurrection

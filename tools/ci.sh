@@ -45,7 +45,10 @@ case "$stage" in
         --devices-a 4 --devices-b 2
     echo "== telemetry smoke (registry/scrape/JSONL/overhead/watchdog)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-      python -m mxnet_tpu.telemetry --selftest ;;
+      python -m mxnet_tpu.telemetry --selftest
+    echo "== static analysis (tracelint/locklint/hloaudit, --strict gate)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.analysis --strict ;;
   full)
     python -m pytest tests/ -q ;;
   tpu)
