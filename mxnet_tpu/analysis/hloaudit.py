@@ -39,18 +39,29 @@ from . import Finding, hlo_budget, package_root
 
 __all__ = ["allreduce_counts", "allreduce_pairing_ok", "has_f64",
            "convert_count", "donated_param_indices", "spmd_allreduces",
+           "spmd_collectives", "collective_counts",
+           "collective_pairing_ok", "collective_wire_bytes",
+           "async_pair_stats", "async_interleave_ok",
            "wire_bytes", "parse_last_metric", "audit_findings",
            "findings_from_report", "amp_wire_findings", "run",
-           "ITEMSIZE", "PROGRAMS"]
+           "ITEMSIZE", "PROGRAMS", "COLLECTIVE_KINDS"]
 
-ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8}
+ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8,
+            "f8e4m3fn": 1, "f8e5m2": 1}
 
-PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "serving_bucket")
+PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "fit_step_zero",
+            "serving_bucket")
+
+# the cross-device data-movement ops the ZeRO lane audits. "-start"
+# suffixed async forms are matched alongside the synchronous spelling;
+# "-done" halves are never counted (one transfer, two instructions).
+COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather")
 
 # where each audited program's defining code lives (finding file field)
 _PROGRAM_FILE = {
     "fit_step_fp32": "parallel/dp.py",
     "fit_step_bf16": "parallel/dp.py",
+    "fit_step_zero": "parallel/zero.py",
     "serving_bucket": "serving/engine.py",
 }
 
@@ -134,6 +145,121 @@ def wire_bytes(ars):
     return total
 
 
+def collective_counts(hlo):
+    """kind -> (n_sync, n_async) over COLLECTIVE_KINDS in one module
+    text. The "(?:-start)?\\(" tail keeps "all-reduce-start(" from being
+    double-counted by the bare spelling and never matches "-done("."""
+    out = {}
+    for kind in COLLECTIVE_KINDS:
+        out[kind] = (len(re.findall(re.escape(kind) + r"\(", hlo)),
+                     len(re.findall(re.escape(kind) + r"-start\(", hlo)))
+    return out
+
+
+def collective_pairing_ok(hlo):
+    """Every async collective start has a matching done, per kind."""
+    return all(
+        hlo.count(f"{kind}-start") == hlo.count(f"{kind}-done")
+        for kind in COLLECTIVE_KINDS)
+
+
+def spmd_collectives(dump_dir, module_substr="jit_step"):
+    """kind -> [(dtype, "d0,d1,...")] for every collective in the
+    post-SPMD dump of modules matching ``module_substr``. Same dump
+    stage as spmd_allreduces (the wire dtype the partitioner chose);
+    reduce-scatter's dumped OUTPUT shape is the per-device SHARD —
+    collective_wire_bytes re-globalizes it with n_dev."""
+    colls = {kind: [] for kind in COLLECTIVE_KINDS}
+    pat = os.path.join(dump_dir,
+                       f"*{module_substr}*after_spmd-partitioning*")
+    kinds = "|".join(re.escape(k) for k in COLLECTIVE_KINDS)
+    rx = re.compile(r"=\s*(\w+)\[([\d,]*)\][^=\n]*?"
+                    rf"({kinds})(?:-start)?\(")
+    for f in sorted(glob.glob(pat)):
+        with open(f, encoding="utf-8") as fh:
+            text = fh.read()
+        for m in rx.finditer(text):
+            colls[m.group(3)].append([m.group(1), m.group(2)])
+    return colls
+
+
+def _elems(shape_csv):
+    n = 1
+    for d in shape_csv.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def collective_wire_bytes(colls, n_dev):
+    """kind -> per-device wire bytes under ring-collective accounting:
+    an all-gather / reduce-scatter of a GLOBAL buffer of S bytes moves
+    (N-1)/N * S per device; an all-reduce moves twice that (it IS a
+    reduce-scatter + all-gather). Dumped output shapes are global for
+    all-reduce/all-gather and the 1/N shard for reduce-scatter."""
+    frac = (n_dev - 1) / n_dev
+    out = {}
+    for kind in COLLECTIVE_KINDS:
+        total = 0.0
+        for dt, shape in colls.get(kind, []):
+            size = ITEMSIZE.get(dt, 4) * _elems(shape)
+            if kind == "reduce-scatter":
+                size *= n_dev
+            mult = 2.0 if kind == "all-reduce" else 1.0
+            total += mult * frac * size
+        out[kind] = int(total)
+    return out
+
+
+# async start/done interleave: the latency-hiding proof. A start opens a
+# window; any sizable compute op issued before its done means the
+# scheduler actually overlapped the collective with computation.
+_ASYNC_START_RX = re.compile(
+    r"(\S+)\s*=\s*[^=\n]*?\b((?:all-reduce|reduce-scatter|all-gather|"
+    r"collective-permute)-start)\(")
+_ASYNC_DONE_RX = re.compile(
+    r"\b(?:all-reduce|reduce-scatter|all-gather|collective-permute)"
+    r"-done\(\s*(\S+?)[\s,)]")
+# ops that represent real computation (NOT bookkeeping like bitcast/
+# tuple/parameter, and NOT a substring of "all-reduce(")
+_COMPUTE_RX = re.compile(
+    r"\b(?:fusion|dot|convolution|custom-call|while)\(")
+
+
+def async_pair_stats(hlo):
+    """{"pairs": n, "interleaved": k}: of n async collective start/done
+    pairs, k had at least one compute op (fusion/dot/convolution/
+    custom-call/while) issued between start and done in program order.
+    Line scanner over the module text: HLO instruction order inside a
+    computation IS the scheduler's issue order in dumped optimized
+    modules."""
+    open_starts = {}            # result var -> compute seen since start
+    pairs = interleaved = 0
+    for line in hlo.splitlines():
+        m = _ASYNC_START_RX.search(line)
+        if m:
+            open_starts[m.group(1).lstrip("%")] = False
+            continue
+        m = _ASYNC_DONE_RX.search(line)
+        if m:
+            var = m.group(1).lstrip("%")
+            if var in open_starts:
+                pairs += 1
+                if open_starts.pop(var):
+                    interleaved += 1
+            continue
+        if open_starts and _COMPUTE_RX.search(line):
+            for var in open_starts:
+                open_starts[var] = True
+    return {"pairs": pairs, "interleaved": interleaved}
+
+
+def async_interleave_ok(stats):
+    """Vacuously true with no async pairs (cpu lowers sync collectives);
+    with pairs present, at least one must bracket compute."""
+    return stats["pairs"] == 0 or stats["interleaved"] > 0
+
+
 def parse_last_metric(stdout, metric):
     """Last JSON line in ``stdout`` whose "metric" field matches, or {}.
     Selftest CLIs print exactly one such line; anything else on stdout
@@ -195,6 +321,45 @@ def _audit_programs():
             "donate_expected": n_leaves,
             "recompiles": int(fn._cache_size()),
         }
+
+    # fit_step_zero: the ZeRO-2 K=2 fused step, tiny bucket threshold so
+    # the layout is multi-bucket (one reduce-scatter per bucket is the
+    # overlap structure the interleave assertion is about)
+    from mxnet_tpu.parallel.zero import ZeroTrainer
+    trz = ZeroTrainer(_mlp_sym(), mesh, zero_stage=2, optimizer="sgd",
+                      learning_rate=0.1, momentum=0.9,
+                      rescale_grad=1.0 / 16, zero_bucket_mb=0.0005)
+    params, states, aux = trz.init_state({"data": (16, 8),
+                                          "softmax_label": (16,)})
+    stacked = trz.shard_inputs([xk, yk], stacked=True)
+    trz._ensure_dev_state(None)
+    fnz = trz._zero_multi_fn(2, "none")
+    hlo = fnz.lower(params, states, trz._resid_dev, aux, stacked,
+                    trz._rng_dev, trz._lr_dev,
+                    trz._t_dev).compile().as_text()
+    cc = collective_counts(hlo)
+    grad_ars = [m for m in re.finditer(
+        r"=\s*(\w+)\[([\d,]*)\][^=\n]*?all-reduce\(", hlo)
+        if m.group(2)]          # non-scalar = gradient-sized
+    donated = donated_param_indices(hlo)
+    n_leaves = len(jax.tree_util.tree_leaves((params, states)))
+    p2, s2, a2, _, _ = trz.step_k(params, states, aux, stacked)
+    trz.step_k(p2, s2, a2, trz.shard_inputs([xk, yk], stacked=True))
+    out["programs"]["fit_step_zero"] = {
+        "allreduce_sync": cc["all-reduce"][0],
+        "allreduce_async": cc["all-reduce"][1],
+        "reduce_scatter": sum(cc["reduce-scatter"]),
+        "all_gather": sum(cc["all-gather"]),
+        "grad_allreduce_nonscalar": len(grad_ars),
+        "buckets": trz._layout.n_buckets,
+        "async": async_pair_stats(hlo),
+        "pairing_ok": collective_pairing_ok(hlo),
+        "has_f64": has_f64(hlo),
+        "convert_count": convert_count(hlo),
+        "donated": sorted(donated),
+        "donate_expected": n_leaves,
+        "recompiles": int(fnz._cache_size()),
+    }
 
     sym = _mlp_sym()
     mod = mx.mod.Module(sym, context=mx.cpu(0))
@@ -260,12 +425,39 @@ def findings_from_report(rec, baseline=None):
         bud = hlo_budget(baseline, prog)
         file = _PROGRAM_FILE.get(prog, "analysis/hloaudit.py")
         n_ar = r["allreduce_sync"] + r["allreduce_async"]
-        if prog.startswith("fit_step") and n_ar == 0:
+        if prog.startswith("fit_step") and prog != "fit_step_zero" \
+                and n_ar == 0:
             findings.append(Finding(
                 "hlo-missing-allreduce", "P0", file, 0,
                 f"{prog}: no gradient all-reduce in the compiled "
                 f"2-device step — data parallelism is not happening",
                 scope=prog))
+        if prog == "fit_step_zero":
+            # the ZeRO-2 invariants: grads move via reduce-scatter (a
+            # grad-sized all-reduce means sharding regressed to dp), and
+            # where the backend emits async pairs they must bracket
+            # compute (the bucketed-overlap proof; cpu lowers sync
+            # collectives, so pairs==0 passes vacuously)
+            if not r.get("reduce_scatter"):
+                findings.append(Finding(
+                    "hlo-zero-missing-reduce-scatter", "P0", file, 0,
+                    f"{prog}: no reduce-scatter in the compiled ZeRO-2 "
+                    f"step — gradient sharding is not happening",
+                    scope=prog))
+            if r.get("grad_allreduce_nonscalar"):
+                findings.append(Finding(
+                    "hlo-zero-grad-allreduce", "P1", file, 0,
+                    f"{prog}: {r['grad_allreduce_nonscalar']} "
+                    f"gradient-sized all-reduce(s) in the ZeRO-2 step — "
+                    f"grads should move via reduce-scatter only",
+                    scope=prog))
+            stats = r.get("async")
+            if stats and not async_interleave_ok(stats):
+                findings.append(Finding(
+                    "hlo-zero-async-interleave", "P1", file, 0,
+                    f"{prog}: {stats['pairs']} async collective pairs, "
+                    f"none bracketing compute — bucketed comm/compute "
+                    f"overlap is not being scheduled", scope=prog))
         if not r["pairing_ok"]:
             findings.append(Finding(
                 "hlo-allreduce-pairing", "P0", file, 0,

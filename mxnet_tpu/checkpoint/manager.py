@@ -563,6 +563,16 @@ class CheckpointManager:
         self._write_file(os.path.join(staging, _TOPOLOGY),
                          json.dumps(topo, indent=1).encode("utf-8"))
 
+    @staticmethod
+    def _zero_ownership(state):
+        """The ZeRO trainer's {array name: owning dp rank} map, when the
+        snapshot carries one — shard placement then mirrors which rank
+        already holds the live optimizer shard."""
+        tmeta = state.meta.get("trainer") or {}
+        zmeta = tmeta.get("zero") or {}
+        own = zmeta.get("ownership")
+        return own if isinstance(own, dict) else None
+
     def _commit(self, state, step, metric):
         if self._nranks > 1 and self.sharded:
             return self._commit_cooperative(state, step, metric)
@@ -575,7 +585,8 @@ class CheckpointManager:
         if os.path.isdir(staging):
             shutil.rmtree(staging)
         os.makedirs(staging)
-        shard_files, shard_map = state.to_shard_files(self.num_shards)
+        shard_files, shard_map = state.to_shard_files(
+            self.num_shards, ownership=self._zero_ownership(state))
         shards = {}
         nbytes = 0
         for k, files in enumerate(shard_files):
@@ -608,7 +619,8 @@ class CheckpointManager:
             shutil.rmtree(staging, ignore_errors=True)
             os.makedirs(staging, exist_ok=True)
         dist.barrier(f"ckpt_stage_{step}")
-        shard_files, shard_map = state.to_shard_files(self.num_shards)
+        shard_files, shard_map = state.to_shard_files(
+            self.num_shards, ownership=self._zero_ownership(state))
         shards = {}
         nbytes = 0
         for k, files in enumerate(shard_files):

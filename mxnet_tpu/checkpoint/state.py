@@ -129,29 +129,46 @@ class TrainingState:
             files.append(("optimizer.bin", ob))
         return files
 
-    def to_shard_files(self, num_shards):
+    def to_shard_files(self, num_shards, ownership=None):
         """Partition the snapshot into `num_shards` independent shard
         file lists plus the array->shard placement map that goes into
         TOPOLOGY.json.
 
-        Placement policy: arrays whose leading axis divides evenly are
-        split along axis 0 (mode "split0" — part k lives in shard k);
-        everything else (scalars, odd leading axes) is placed whole,
-        round-robin by sorted name (mode "whole"). The opaque optimizer
-        pickle always lands in shard 0. A shard can end up empty — its
-        manifest then just lists no payload files.
+        Placement policy: an `ownership` map ({array name: shard index},
+        e.g. the ZeRO trainer's optimizer-shard ownership) pins those
+        arrays whole onto the rank that already owns the live copy, so
+        a cooperative sharded commit writes exactly the shards a rank
+        holds — no re-gather on the save path. Remaining arrays whose
+        leading axis divides evenly are split along axis 0 (mode
+        "split0" — part k lives in shard k); everything else (scalars,
+        odd leading axes) is placed whole, round-robin by sorted name
+        (mode "whole"). The opaque optimizer pickle always lands in
+        shard 0. A shard can end up empty — its manifest then just
+        lists no payload files.
 
         Returns (files_per_shard, shard_map) where files_per_shard[k] is
         the [(fname, bytes)] write list of shard k.
         """
         num_shards = max(1, int(num_shards))
+        owned = {}
+        for name, k in (ownership or {}).items():
+            try:
+                k = int(k)
+            except (TypeError, ValueError):
+                continue
+            if 0 <= k < num_shards:
+                owned[name] = k
         host = {k: _host(v) for k, v in self.arrays.items()}
         shard_arrays = [dict() for _ in range(num_shards)]
         shard_map = {}
         rr = 0
         for name in sorted(host):
             a = host[name]
-            if num_shards > 1 and a.ndim >= 1 \
+            if name in owned:
+                k = owned[name]
+                shard_arrays[k][name] = a
+                shard_map[name] = {"mode": "whole", "shard": k}
+            elif num_shards > 1 and a.ndim >= 1 \
                     and a.shape[0] >= num_shards \
                     and a.shape[0] % num_shards == 0:
                 for k, part in enumerate(

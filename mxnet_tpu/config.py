@@ -106,6 +106,17 @@ _DOCUMENTED = {
     "MXNET_TELEMETRY_LOG": None,
     "MXNET_TELEMETRY_STALL_S": None,
     "MXNET_TELEMETRY_STALL_PATH": None,
+    # ZeRO-sharded data parallelism (mxnet_tpu.parallel.zero,
+    # docs/ZERO.md): MXNET_ZERO_STAGE=1|2 makes DataParallelTrainer(...)
+    # construct a ZeroTrainer that shards fp32 masters + optimizer state
+    # across the dp axis (1 = all-reduce + update own shard, 2 =
+    # reduce-scatter); MXNET_ZERO_BUCKET_MB sizes the gradient buckets
+    # whose reduce-scatter overlaps the next bucket's backward;
+    # MXNET_GRAD_COMPRESS=bf16|fp8 casts gradients to a narrow wire
+    # dtype with an error-feedback residual carried in the step state
+    "MXNET_ZERO_STAGE": 0,
+    "MXNET_ZERO_BUCKET_MB": "4",
+    "MXNET_GRAD_COMPRESS": "none",
     # static analysis (mxnet_tpu.analysis, docs/ANALYSIS.md):
     # MXNET_ANALYSIS_BASELINE=<path> points the finding-suppression
     # baseline somewhere other than tools/analysis_baseline.json;
@@ -167,6 +178,29 @@ def enable_compile_cache(path):
             # program compiled before the dir was set, the cache sits
             # initialized-with-no-dir and silently writes nothing —
             # re-initialize so the new dir takes effect mid-process
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        return True
+    except Exception:
+        return False
+
+
+def disable_compile_cache():
+    """Undo enable_compile_cache: detach the persistent cache dir and
+    drop jax's latched cache handle, so later compiles in this process
+    go straight to XLA again. Needed by anything that enables the cache
+    temporarily (bench.py's compile_cache lane): on the cpu backend,
+    leaving the persistent cache armed has been observed to corrupt
+    later unrelated compiles (libc-level segfault executing a
+    freshly-compiled donated trainer step, jax 0.4.37 — reproduced with
+    the cache as the only variable), and it skews any subsequently
+    TIMED compile with cache-write I/O. Returns True when detached."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
             from jax._src import compilation_cache as _cc
             _cc.reset_cache()
         except Exception:

@@ -170,11 +170,11 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
         # buffers, so binding the live arrays into the net would leave the
         # net (and any epoch_callback snapshot) holding deleted buffers
         # after the next epoch's first dispatch
-        for n, p in zip(trainer.param_names, params):
-            pmap[n].set_data(nd_array(np.asarray(p)))
-        for n, a in zip(trainer.aux_names, aux):
+        for n, p in trainer.host_params(params).items():
+            pmap[n].set_data(nd_array(p))
+        for n, a in trainer.host_aux(aux).items():
             if n in pmap:
-                pmap[n].set_data(nd_array(np.asarray(a)))
+                pmap[n].set_data(nd_array(a))
 
     from ..pipeline import feed_or_inline, close_feed
 

@@ -624,10 +624,10 @@ class Module(BaseModule):
                 # executor would leave it holding deleted arrays after the
                 # next epoch's first dispatch
                 self.set_params(
-                    {n: NDArray(np.asarray(p)) for n, p in
-                     zip(trainer.param_names, params)},
-                    {n: NDArray(np.asarray(a))
-                     for n, a in zip(trainer.aux_names, aux)})
+                    {n: NDArray(v)
+                     for n, v in trainer.host_params(params).items()},
+                    {n: NDArray(v)
+                     for n, v in trainer.host_aux(aux).items()})
                 snapshot_args, snapshot_aux = self.get_params()
                 for callback in epoch_callbacks:
                     callback(epoch, self.symbol, snapshot_args,

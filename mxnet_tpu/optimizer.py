@@ -758,9 +758,16 @@ class Updater:
             self.states = states
         self.states_synced = dict.fromkeys(self.states.keys(), False)
 
-    def get_states(self, dump_optimizer=False):
-        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
-                            else self.states)
+    def get_states(self, dump_optimizer=False, keys=None):
+        """Pickle the optimizer state. `keys` restricts the dump to the
+        given state indices — a ZeRO rank passes the indices it owns so
+        a sharded save serializes only its 1/N of the optimizer state
+        (missing keys are simply absent; set_states on a merged stream
+        restores the union)."""
+        states = self.states if keys is None else {
+            k: self.states[k] for k in keys if k in self.states}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
 
 
 def get_updater(optimizer):

@@ -43,11 +43,27 @@ class DataParallelTrainer:
     engine behind bench.py and the dryrun_multichip driver hook.
     """
 
+    def __new__(cls, *args, **kwargs):
+        # MXNET_ZERO_STAGE (or an explicit zero_stage kwarg) reroutes
+        # plain DataParallelTrainer construction to the ZeRO-sharded
+        # engine (parallel/zero.py) — same constructor surface, same
+        # step contract, sharded masters/optimizer state. Subclasses
+        # dispatch themselves, so only direct construction reroutes.
+        if cls is DataParallelTrainer:
+            from .zero import resolve_stage, ZeroTrainer
+            if resolve_stage(kwargs.get("zero_stage")) > 0:
+                return object.__new__(ZeroTrainer)
+        return object.__new__(cls)
+
     def __init__(self, symbol, mesh, data_names=("data",),
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
                  clip_gradient=None, loss_index=0, dtype="float32",
-                 input_preproc=None, loss_scaler=None, **opt_kwargs):
+                 input_preproc=None, loss_scaler=None, zero_stage=None,
+                 zero_bucket_mb=None, grad_compress=None, **opt_kwargs):
+        # zero_stage/zero_bucket_mb/grad_compress belong to the ZeRO
+        # subclass; accepted (and ignored) here so a stage-0 run can keep
+        # them in its construction kwargs
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..ops.registry import get_op, AttrDict, OpCtx
 
@@ -145,6 +161,17 @@ class DataParallelTrainer:
         # ImageRecordIter(output_dtype="uint8")); XLA fuses it into the
         # first conv's input chain
         preproc_names = [arg_names[p] for p in input_pos]
+        # the step-building surface, kept on self so subclasses
+        # (parallel/zero.py) can assemble their own step program from the
+        # same runner/optimizer-op plumbing
+        self._run = run
+        self._fcompute = fcompute
+        self._attrs = attrs
+        self._has_t = has_t
+        self._is_adam = is_adam
+        self._cast_input = cast_input
+        self._preproc_names = preproc_names
+        self._input_preproc = input_preproc
 
         def _step_impl(params, states, aux, inputs, rng, lr, t, ls):
             # rng and t are device-carried: split/increment INSIDE the
@@ -485,27 +512,30 @@ class DataParallelTrainer:
         return {"amp_scale": self.loss_scale,
                 "amp_skipped_steps": self.skipped_steps}
 
+    # -- host views ---------------------------------------------------------
+
+    def host_params(self, params):
+        """name -> host numpy array for the trainer's params tuple. The
+        generic spelling fused-fit loops must use for writeback: ZeRO
+        subclasses carry flat sharded buckets instead of per-parameter
+        replicas, and override this to unflatten them."""
+        return {n: _np.asarray(p)
+                for n, p in zip(self._param_names, params)}
+
+    def host_aux(self, aux):
+        """name -> host numpy array for the aux tuple (replicated on
+        every trainer variant)."""
+        return {n: _np.asarray(a) for n, a in zip(self._aux_names, aux)}
+
     # -- checkpoint round-trip ----------------------------------------------
 
-    def export_training_state(self, params, states, aux):
-        """Host snapshot of the full fused-loop training state: the
-        (donated, device-carried) params/opt-states/aux tuples as numpy,
-        plus the device-carried step counter, PRNG key chain position and
-        fp16 loss-scaler vector. Everything mxnet_tpu.checkpoint needs for
-        a bit-identical step_k continuation after restore. Must be called
-        between dispatches (the tuples are invalidated by the next step's
-        donation — copy now, serialize later)."""
+    def _export_meta(self):
+        """Scalar device-carried step state (t, rng chain position, fp16
+        loss-scaler vector, exporting mesh) — shared by every trainer
+        variant's export_training_state."""
         from .. import random as _random
-        arrays = {}
-        for n, p in zip(self._param_names, params):
-            arrays[f"param:{n}"] = _np.asarray(p)
-        for n, st in zip(self._param_names, states):
-            for i, s in enumerate(st):
-                arrays[f"opt:{n}:{i}"] = _np.asarray(s)
-        for n, a in zip(self._aux_names, aux):
-            arrays[f"aux:{n}"] = _np.asarray(a)
         from .mesh import mesh_descriptor
-        meta = {
+        return {
             "t": float(self._t if self._t_dev is None
                        else _np.asarray(self._t_dev)),
             "rng": None if self._rng_dev is None
@@ -518,21 +548,11 @@ class DataParallelTrainer:
             # CURRENT mesh is what reshards an elastic restore)
             "mesh": mesh_descriptor(self._mesh),
         }
-        return arrays, meta
 
-    def import_training_state(self, arrays, meta):
-        """Inverse of export_training_state: re-commit a snapshot to the
-        mesh. Returns (params, states, aux) replicated tuples ready for
-        step/step_k; the internal t/rng/loss-scaler carries are restored
-        so the continuation is bit-identical to the uninterrupted run."""
+    def _import_scalar_state(self, meta):
+        """Inverse of _export_meta: restore t/rng/loss-scaler carries."""
         from .. import random as _random
         put = lambda v: jax.device_put(_np.asarray(v), self._repl)
-        params = tuple(put(arrays[f"param:{n}"]) for n in self._param_names)
-        states = tuple(
-            tuple(put(arrays[f"opt:{n}:{i}"])
-                  for i in range(self._n_states))
-            for n in self._param_names)
-        aux = tuple(put(arrays[f"aux:{n}"]) for n in self._aux_names)
         self._t = float(meta.get("t", 0.0))
         self._t_dev = put(_np.float32(self._t))
         if meta.get("rng") is not None:
@@ -541,6 +561,38 @@ class DataParallelTrainer:
         ls = meta.get("loss_scaler")
         if ls is not None and self._has_ls:
             self._ls_dev = put(_np.asarray(ls, _np.float32))
+
+    def export_training_state(self, params, states, aux):
+        """Host snapshot of the full fused-loop training state: the
+        (donated, device-carried) params/opt-states/aux tuples as numpy,
+        plus the device-carried step counter, PRNG key chain position and
+        fp16 loss-scaler vector. Everything mxnet_tpu.checkpoint needs for
+        a bit-identical step_k continuation after restore. Must be called
+        between dispatches (the tuples are invalidated by the next step's
+        donation — copy now, serialize later)."""
+        arrays = {}
+        for n, p in zip(self._param_names, params):
+            arrays[f"param:{n}"] = _np.asarray(p)
+        for n, st in zip(self._param_names, states):
+            for i, s in enumerate(st):
+                arrays[f"opt:{n}:{i}"] = _np.asarray(s)
+        for n, a in zip(self._aux_names, aux):
+            arrays[f"aux:{n}"] = _np.asarray(a)
+        return arrays, self._export_meta()
+
+    def import_training_state(self, arrays, meta):
+        """Inverse of export_training_state: re-commit a snapshot to the
+        mesh. Returns (params, states, aux) replicated tuples ready for
+        step/step_k; the internal t/rng/loss-scaler carries are restored
+        so the continuation is bit-identical to the uninterrupted run."""
+        put = lambda v: jax.device_put(_np.asarray(v), self._repl)
+        params = tuple(put(arrays[f"param:{n}"]) for n in self._param_names)
+        states = tuple(
+            tuple(put(arrays[f"opt:{n}:{i}"])
+                  for i in range(self._n_states))
+            for n in self._param_names)
+        aux = tuple(put(arrays[f"aux:{n}"]) for n in self._aux_names)
+        self._import_scalar_state(meta)
         return params, states, aux
 
     def step(self, params, states, aux, inputs, rng=None):

@@ -106,6 +106,7 @@ class StepLogger:
                              help="instantaneous training throughput")
         # subsystem counter baselines for per-step deltas
         self._ckpt_last = self._ckpt_counters()
+        self._zero_last = self._zero_counters()
         path = _log_path()
         if path:
             try:
@@ -125,6 +126,18 @@ class StepLogger:
             return {"ckpt_save_us": 0, "ckpt_wait_us": 0}
         return {"ckpt_save_us": int(c.get("ckpt_save_us", 0)),
                 "ckpt_wait_us": int(c.get("ckpt_wait_us", 0))}
+
+    @staticmethod
+    def _zero_counters():
+        """ZeRO wire/overlap counters (parallel.zero registers its
+        profiler counter-export hook only once a ZeroTrainer exists;
+        None until then keeps the JSONL free of dead zero_* keys)."""
+        from .. import profiler
+        c = profiler.export_counter("zero")
+        if not isinstance(c, dict):
+            return None
+        return {"zero_wire_bytes": int(c.get("zero_wire_bytes", 0)),
+                "zero_overlap_frac": c.get("zero_overlap_frac")}
 
     @staticmethod
     def _amp_sample():
@@ -182,8 +195,15 @@ class StepLogger:
                - self._ckpt_last["ckpt_save_us"],
                "ckpt_wait_us": ckpt["ckpt_wait_us"]
                - self._ckpt_last["ckpt_wait_us"]}
+        zero = self._zero_counters()
+        if zero is not None:
+            last = self._zero_last or {"zero_wire_bytes": 0}
+            rec["zero_wire_bytes"] = zero["zero_wire_bytes"] \
+                - last.get("zero_wire_bytes", 0)
+            rec["zero_overlap_frac"] = zero["zero_overlap_frac"]
         with self._lock:
             self._ckpt_last = ckpt
+            self._zero_last = zero
         if extra:
             rec.update(extra)
         self._emit(rec)

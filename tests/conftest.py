@@ -76,6 +76,8 @@ _QUICK = {
     "test_telemetry.py::test_registry_absorbs_profiler_hooks_and_dedups",
     "test_telemetry.py::test_exporter_scrape_during_live_fit",
     "test_telemetry.py::test_watchdog_stall_dump_and_rearm",
+    "test_zero.py::test_zero1_fp32_bit_identical",
+    "test_zero.py::test_resume_across_stage_change",
     "test_analysis.py::test_repo_is_clean_under_strict",
     "test_analysis.py::test_amp_wire_invariant_via_auditor",
     "test_analysis.py::test_tracelint_item_sync_in_scanned_step",

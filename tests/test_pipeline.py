@@ -269,16 +269,23 @@ def test_overlap_frac_bounds():
 def test_enable_compile_cache_writes_entries(tmp_path):
     import jax
     import jax.numpy as jnp
-    from mxnet_tpu.config import enable_compile_cache
+    from mxnet_tpu.config import disable_compile_cache, enable_compile_cache
     cache_dir = str(tmp_path / "xla_cache")
+    # detach afterwards: an armed persistent cache is process-global and
+    # has been observed to segfault later unrelated cpu compiles (the
+    # shard_map trainer steps of test_zero.py, and bench.py's checkpoint
+    # lane before it detached too — see config.disable_compile_cache)
     assert enable_compile_cache(cache_dir)
-    @jax.jit
-    def fn(x):
-        return jnp.tanh(x) @ x.T
-    np.asarray(fn(np.ones((32, 32), np.float32)))
-    entries = os.listdir(cache_dir)
-    assert entries, "no cache entries written"
-    # warm path: in-process executables dropped, disk cache survives
-    jax.clear_caches()
-    np.asarray(fn(np.ones((32, 32), np.float32)))
-    assert len(os.listdir(cache_dir)) >= len(entries)
+    try:
+        @jax.jit
+        def fn(x):
+            return jnp.tanh(x) @ x.T
+        np.asarray(fn(np.ones((32, 32), np.float32)))
+        entries = os.listdir(cache_dir)
+        assert entries, "no cache entries written"
+        # warm path: in-process executables dropped, disk cache survives
+        jax.clear_caches()
+        np.asarray(fn(np.ones((32, 32), np.float32)))
+        assert len(os.listdir(cache_dir)) >= len(entries)
+    finally:
+        assert disable_compile_cache()
