@@ -909,6 +909,45 @@ def _zero_lane():
         f"{(proc.stderr or '').strip()[-300:]}")
 
 
+def _dist_recovery_lane():
+    """Distributed-runtime recovery (mxnet_tpu.cluster, ISSUE 12): a real
+    2-process jax.distributed gang on the Gloo CPU backend — barrier
+    latency, then an injected SIGKILL pre-barrier timed from victim
+    death to the survivor's DistRankFailure exit (detect_s), then a kill
+    mid-cooperative-commit with a supervised restart resuming from the
+    last sealed checkpoint (mttr_s). Runs `python -m mxnet_tpu.cluster
+    --bench` in a fresh subprocess: each rank needs its own 1-device
+    backend pinned before jax initializes, and this process already
+    consumed an 8-device mesh."""
+    import subprocess
+    import sys
+
+    env = os.environ.copy()
+    for k in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES", "MXNET_CLUSTER_INJECT"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.cluster", "--bench",
+         "--nprocs", "2"],
+        capture_output=True, text=True, timeout=360, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "dist_recovery":
+            rec.pop("metric")
+            if rec.pop("skipped", None):
+                rec["status"] = "skipped: no gloo CPU collectives"
+            elif not rec.get("ok"):
+                raise RuntimeError(
+                    f"dist_recovery selftest failed: {rec.get('error')}")
+            return rec
+    raise RuntimeError(
+        f"cluster bench subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or '').strip()[-300:]}")
+
+
 def _checkpoint_lane():
     """Checkpoint overhead A/B (mxnet_tpu.checkpoint, ISSUE 5): the amp
     lane's MLP stepped with NO checkpoints, with SYNCHRONOUS full-state
@@ -1442,6 +1481,15 @@ def main(argv=None):
     except Exception as e:
         elastic_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("elastic_ckpt", elastic_lane)
+    # distributed-runtime recovery: 2-process gang barrier latency,
+    # injected-kill detection latency, restart-resume MTTR (ISSUE 12)
+    try:
+        dist_lane = _gated("dist_recovery", 90, _dist_recovery_lane)
+    except _BudgetExceeded:
+        dist_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        dist_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("dist_recovery", dist_lane)
     # step-telemetry overhead A/B + /metrics scrape latency (ISSUE 6)
     try:
         tele_lane = _gated("telemetry", 60, _telemetry_lane)
@@ -1582,6 +1630,14 @@ def main(argv=None):
             "restore_ms", elastic_lane.get("status")),
         "elastic_ckpt_reshard_bytes": elastic_lane.get("reshard_bytes"),
         "elastic_ckpt_bit_identical": elastic_lane.get("bit_identical"),
+        # distributed recovery (ISSUE 12): 2-process gang barrier
+        # latency, SIGKILL-to-DistRankFailure detection latency, and
+        # kill-mid-commit restart-resume MTTR (full payload streamed
+        # above as the "dist_recovery" lane line)
+        "dist_barrier_us_mean": dist_lane.get(
+            "barrier_us_mean", dist_lane.get("status")),
+        "dist_kill_detect_s": dist_lane.get("detect_s"),
+        "dist_restart_mttr_s": dist_lane.get("mttr_s"),
         # step telemetry (ISSUE 6): recorder-on overhead vs bare loop +
         # /metrics scrape latency (full payload streamed above)
         "telemetry_overhead_pct": tele_lane.get(

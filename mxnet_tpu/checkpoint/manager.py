@@ -608,8 +608,18 @@ class CheckpointManager:
         after the all-shards barrier, rank 0 seals the step with
         TOPOLOGY.json and the atomic rename. A kill at any instant
         leaves either the old newest step (seal missing -> restore falls
-        back) or the complete new one."""
+        back) or the complete new one.
+
+        Injection points (cluster harness, MXNET_CLUSTER_INJECT):
+        `pre-commit` at entry, `mid-cooperative-commit` after this
+        rank's own shards land but before the all-shards barrier,
+        `pre-seal` on rank 0 with every shard on disk but TOPOLOGY.json
+        unwritten. A rank lost at any of them leaves the step unsealed
+        and turns the survivors' barrier waits into DistRankFailure
+        within MXNET_DIST_TIMEOUT_S (dist.py's timeout rendezvous)."""
         from .. import dist
+        from ..cluster.inject import maybe_inject
+        maybe_inject("pre-commit")
         t0 = time.perf_counter()
         final = os.path.join(self.directory, self._step_dirname(step))
         staging = os.path.join(
@@ -629,6 +639,7 @@ class CheckpointManager:
             sname, msha, n = self._write_shard(staging, k, files, step)
             shards[sname] = {"manifest_sha256": msha}
             nbytes += n
+        maybe_inject("mid-cooperative-commit")
         dist.barrier(f"ckpt_shards_{step}")
         if self._rank == 0:
             # other ranks' manifest checksums are re-derived from disk —
@@ -642,6 +653,7 @@ class CheckpointManager:
                 shards[sname] = {
                     "manifest_sha256":
                         hashlib.sha256(mpayload).hexdigest()}
+            maybe_inject("pre-seal")
             self._seal_step(staging, state, step, metric, shards,
                             shard_map)
             _maybe_crash("pre-rename", step)

@@ -1,0 +1,38 @@
+"""mxnet_tpu.cluster — multi-process launch/supervise/fault-inject harness.
+
+Beyond-reference subsystem (docs/CLUSTER.md) closing ROADMAP's
+"multi-process collective harness" item: the reference's dmlc-tracker
+launched remote worker/server gangs; here the testable pod is N real
+Python processes joined into one `jax.distributed` job on localhost.
+
+Three pieces:
+
+  - **launcher** (launcher.py): `ClusterLauncher` spawns the gang with
+    per-rank CPU-device pinning + the Gloo CPU-collectives backend,
+    streams rank-prefixed logs, enforces a wall-clock deadline, and
+    reaps the whole tree when ranks wedge after a death.
+  - **inject** (inject.py): `MXNET_CLUSTER_INJECT=<kill|hang|exit>@
+    <point>[:rank][@<n>]` — named injection points threaded through
+    dist.py and the cooperative checkpoint commit.
+  - **selftest** (__main__.py): `python -m mxnet_tpu.cluster --selftest
+    --nprocs 2` (the ci.sh quick smoke), `--matrix` for the full
+    injection matrix including the kill-mid-cooperative-commit
+    sha256-identity proof, `--bench` for the bench.py dist_recovery
+    lane.
+
+The runtime-hardening half lives in `mxnet_tpu.dist`: timeout barriers,
+`DistRankFailure` naming missing ranks, coordinated abort
+(`MXNET_DIST_TIMEOUT_S` / `MXNET_DIST_RETRIES`).
+"""
+from __future__ import annotations
+
+from .launcher import (ClusterLauncher, ClusterResult, RankProc,
+                       cpu_collectives_available, free_port)
+from .inject import (ACTIONS, ENV_VAR, INJECTION_POINTS, InjectSpec,
+                     maybe_inject, parse_spec)
+from ..dist import DistRankFailure
+
+__all__ = ["ClusterLauncher", "ClusterResult", "RankProc",
+           "cpu_collectives_available", "free_port", "DistRankFailure",
+           "ACTIONS", "ENV_VAR", "INJECTION_POINTS", "InjectSpec",
+           "maybe_inject", "parse_spec"]

@@ -1,0 +1,473 @@
+"""Cluster harness selftest CLI — fault-injection proof of the
+multi-process runtime.
+
+    python -m mxnet_tpu.cluster --selftest --nprocs 2   # ci smoke (~20s)
+    python -m mxnet_tpu.cluster --selftest --matrix     # full injection matrix
+    python -m mxnet_tpu.cluster --bench                 # dist_recovery JSON
+    python -m mxnet_tpu.cluster -n 2 [--deadline S] <cmd...>   # launch/supervise
+
+Smoke phases (ci.sh quick): a 2-process barrier/collective round-trip;
+an injected SIGKILL pre-barrier whose survivor raises `DistRankFailure`
+naming the dead rank within MXNET_DIST_TIMEOUT_S; a kill mid-cooperative
+checkpoint commit (torn step never sealed) followed by a
+supervisor-driven restart that resumes from the last sealed commit and
+finishes the run.
+
+`--matrix` adds the acceptance proofs: the torn step's restored
+`state_sha256` equals an uninterrupted baseline's same-step hash (and so
+do every post-resume commit's), a SIGSTOP hang whose survivor aborts and
+whose frozen rank the supervisor reaps, an `exit` mid-step whose
+survivor turns the dead collective into `DistRankFailure`, and a rank-0
+kill pre-seal (taking the coordination service with it). Every phase
+asserts the harness deadline reaper did NOT fire — injected faults must
+end in named failures, never in the supervisor's last-resort kill.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+from .launcher import ClusterLauncher, cpu_collectives_available
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# short fuse for the injection phases: every survivor must detect and
+# abort well inside the phase deadline
+_TIMEOUT_S = 5.0
+_STEPS, _PERIOD = 12, 4         # commits at steps 4, 8, 12; faults
+_TORN_STEP = 8                  # target the 2nd commit (@2): step 8
+
+
+class SelftestFailure(AssertionError):
+    pass
+
+
+def _check(cond, msg):
+    if not cond:
+        raise SelftestFailure(msg)
+
+
+def _events(result):
+    """Parse the per-rank JSON event lines the workers print."""
+    evs = []
+    for rank, text in sorted(result.tails.items()):
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"evt"' in line:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                d["_rank"] = rank
+                evs.append(d)
+    return evs
+
+
+def _base_env():
+    env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            ""),
+           "MXNET_TELEMETRY": "0"}
+    # injection specs/timeouts must come from each phase alone, not leak
+    # in from the caller's environment
+    return env
+
+
+_BARRIER_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+import mxnet_tpu as mx              # joins dist via the DMLC_* contract
+from mxnet_tpu import dist
+
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+assert dist.is_initialized(), "worker did not join the dist job"
+
+total = dist.allreduce_sum(np.full((4,), float(rank + 1), np.float32))
+assert float(total[0]) == n * (n + 1) / 2.0, total
+got = dist.broadcast_from_root(
+    np.full((2,), 5.0 if rank == 0 else -1.0, np.float32))
+assert float(got[0]) == 5.0, got
+
+lat = []
+for i in range(3):
+    t0 = time.perf_counter()
+    dist.barrier(f"smoke_{i}")
+    lat.append(time.perf_counter() - t0)
+print(json.dumps({"evt": "barrier_ok", "rank": rank,
+                  "barrier_us": [round(x * 1e6, 1) for x in lat],
+                  "t": time.time()}), flush=True)
+"""
+
+
+_TRAIN_WORKER = r"""
+'''Deterministic 2-rank dist_sync "fit": seeded params, grads a pure
+function of (step, rank, key) so any resumed run retraces the baseline
+trajectory bit-for-bit; cooperative sharded checkpoint every PERIOD
+steps.'''
+import json, os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+
+ckdir, steps, period = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+resume = len(sys.argv) > 4 and sys.argv[4] == "resume"
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+nranks = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+kv = mx.kv.create("dist_sync")
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+names = ["w0", "w1", "w2", "w3"]
+rng = np.random.RandomState(7)
+init = {n: rng.normal(size=(16, 4)).astype(np.float32) for n in names}
+
+mgr = CheckpointManager(ckdir, sharded=True, async_save=False,
+                        keep_last_n=0, num_shards=4)
+start, vals = 0, init
+if resume:
+    st = mgr.restore()
+    if st is not None:
+        start = int(st.meta["step"])
+        vals = {n: st.arrays[f"param:{n}"] for n in names}
+        print(json.dumps({"evt": "resumed", "rank": rank, "step": start,
+                          "t": time.time()}), flush=True)
+for n in names:
+    kv.init(n, mx.nd.array(vals[n]))        # broadcasts rank 0's values
+
+def snap(step):
+    arrays = {}
+    for n in names:
+        out = mx.nd.zeros(init[n].shape)
+        kv.pull(n, out=out)
+        arrays[f"param:{n}"] = out.asnumpy()
+    return TrainingState(arrays=arrays, meta={"step": int(step)})
+
+for step in range(start + 1, steps + 1):
+    for i, n in enumerate(names):
+        g = (np.cos(0.37 * step * (i + 1) + float(rank))
+             * np.ones(init[n].shape, np.float32) * 0.01)
+        kv.push(n, mx.nd.array(g))
+    print(json.dumps({"evt": "step", "rank": rank, "step": step,
+                      "t": time.time()}), flush=True)
+    if step % period == 0:
+        st = snap(step)
+        mgr.save(st, step)
+        if rank == 0:
+            print(json.dumps({"evt": "commit", "step": step,
+                              "sha": state_sha256(st),
+                              "t": time.time()}), flush=True)
+
+dist.barrier("selftest_end")
+print(json.dumps({"evt": "final", "rank": rank, "step": steps,
+                  "sha": state_sha256(snap(steps)), "ok": True,
+                  "t": time.time()}), flush=True)
+"""
+
+
+def _launcher(nprocs, deadline_s, inject=None, retries=0, stream=True):
+    return ClusterLauncher(nprocs=nprocs, deadline_s=deadline_s,
+                           dist_timeout_s=_TIMEOUT_S,
+                           dist_retries=retries, inject=inject,
+                           env=_base_env(), stream=stream)
+
+
+def _no_reap(result, phase):
+    _check(not result.deadline_fired,
+           f"{phase}: harness deadline reaper fired "
+           f"({result.describe()}) — an injected fault hung past every "
+           "runtime timeout")
+
+
+def _survivor_failed(result, victim, phase):
+    """Common injected-fault postcondition: the victim is dead by the
+    injected means, every survivor exited nonzero on its own with a
+    DistRankFailure on record, and nobody needed the deadline reaper."""
+    _no_reap(result, phase)
+    for rank, rc in enumerate(result.returncodes):
+        if rank == victim:
+            continue
+        _check(rc not in (0, None),
+               f"{phase}: surviving rank {rank} exited rc={rc}; "
+               "expected a nonzero DistRankFailure exit")
+        # when the COORDINATOR (rank 0) is the victim, jax's own
+        # coordination client detects the death at the C++ layer and
+        # terminates the survivor before Python sees an exception —
+        # that is prompt coordinated abort too, just jax's spelling
+        _check(rank in result.reaped_ranks
+               or "DistRankFailure" in result.tails[rank]
+               or "JAX distributed service detected fatal errors"
+               in result.tails[rank],
+               f"{phase}: rank {rank} log has no DistRankFailure:\n"
+               + result.tails[rank][-2000:])
+
+
+# -- phases ------------------------------------------------------------------
+
+def phase_barrier_roundtrip(nprocs, report):
+    res = _launcher(nprocs, deadline_s=60.0).launch_python(
+        _BARRIER_WORKER)
+    _no_reap(res, "barrier_roundtrip")
+    _check(res.ok, "barrier_roundtrip: " + res.describe()
+           + "\n" + "".join(res.tails.values())[-2000:])
+    evs = [e for e in _events(res) if e["evt"] == "barrier_ok"]
+    _check(len(evs) == nprocs, f"barrier_roundtrip: {len(evs)}/{nprocs} "
+                               "ranks reported")
+    lats = [u for e in evs for u in e["barrier_us"]]
+    report["barrier_us_mean"] = round(sum(lats) / len(lats), 1)
+    report["barrier_us_max"] = round(max(lats), 1)
+    print(f"cluster-selftest: barrier_roundtrip OK "
+          f"(mean {report['barrier_us_mean']}us over {len(lats)} waits)")
+
+
+def phase_kill_pre_barrier(nprocs, report):
+    victim = nprocs - 1
+    res = _launcher(nprocs, deadline_s=90.0,
+                    inject=f"kill@pre-barrier:{victim}@2").launch_python(
+        _BARRIER_WORKER)
+    _check(res.returncodes[victim] == -9,
+           f"kill_pre_barrier: victim rc={res.returncodes[victim]}, "
+           "expected SIGKILL (-9)")
+    _survivor_failed(res, victim, "kill_pre_barrier")
+    _check(f"missing rank(s): {victim}" in res.tails[0],
+           "kill_pre_barrier: survivor did not NAME the dead rank:\n"
+           + res.tails[0][-2000:])
+    detect = res.exit_s[0] - res.exit_s[victim]
+    _check(detect < _TIMEOUT_S + 6.0,
+           f"kill_pre_barrier: detection took {detect:.1f}s, expected "
+           f"within timeout {_TIMEOUT_S}s (+scheduling margin)")
+    report["detect_s"] = round(detect, 2)
+    print(f"cluster-selftest: kill_pre_barrier OK "
+          f"(DistRankFailure named rank {victim} in {detect:.1f}s)")
+
+
+def phase_restart_resume(nprocs, report, check_shas=None):
+    """Kill a rank mid-cooperative-commit (2nd commit, step 8): the torn
+    step must never seal; a supervisor restart resumes from the last
+    sealed commit and finishes. With `check_shas` (the matrix's baseline
+    {step: sha}), also prove restored + post-resume hashes match the
+    uninterrupted baseline."""
+    ckdir = tempfile.mkdtemp(prefix="mxnet_cluster_ck_")
+    victim = nprocs - 1
+    args = (ckdir, _STEPS, _PERIOD)
+    t_run1 = time.time()
+    res = _launcher(nprocs, deadline_s=90.0,
+                    inject=f"kill@mid-cooperative-commit:{victim}@2",
+                    ).launch_python(_TRAIN_WORKER, args)
+    _check(res.returncodes[victim] == -9,
+           f"restart_resume: victim rc={res.returncodes[victim]}, "
+           "expected SIGKILL (-9)")
+    _survivor_failed(res, victim, "restart_resume")
+    death_wall = t_run1 + (res.first_death_s or res.elapsed_s)
+
+    from ..checkpoint import CheckpointManager
+    mgr = CheckpointManager(ckdir, keep_last_n=0)
+    sealed = mgr.steps()
+    _check(sealed == [_PERIOD],
+           f"restart_resume: sealed steps {sealed}, expected only "
+           f"[{_PERIOD}] — the torn step-{_TORN_STEP} commit must never "
+           "seal")
+    if check_shas:
+        from ..checkpoint.state import state_sha256
+        st = mgr.restore()
+        _check(st is not None, "restart_resume: restore() of the last "
+                               "sealed commit failed")
+        _check(int(st.meta["step"]) == _PERIOD,
+               f"restart_resume: restored step {st.meta['step']}")
+        got = state_sha256(st)
+        _check(got == check_shas[_PERIOD],
+               f"restart_resume: restored step-{_PERIOD} sha {got[:12]} "
+               f"!= uninterrupted baseline {check_shas[_PERIOD][:12]}")
+    mgr.close()
+
+    res2 = _launcher(nprocs, deadline_s=90.0).launch_python(
+        _TRAIN_WORKER, (*args, "resume"))
+    _no_reap(res2, "restart_resume(2)")
+    _check(res2.ok, "restart_resume: restarted run failed: "
+           + res2.describe() + "\n"
+           + "".join(res2.tails.values())[-2000:])
+    evs = _events(res2)
+    resumed = [e for e in evs if e["evt"] == "resumed"]
+    _check(len(resumed) == nprocs and
+           all(e["step"] == _PERIOD for e in resumed),
+           f"restart_resume: ranks did not resume from step {_PERIOD}: "
+           f"{resumed}")
+    finals = [e for e in evs if e["evt"] == "final"]
+    _check(len(finals) == nprocs and
+           len({e["sha"] for e in finals}) == 1,
+           f"restart_resume: final states disagree across ranks: "
+           f"{finals}")
+    steps_evs = [e for e in evs if e["evt"] == "step"]
+    first_step_t = min(e["t"] for e in steps_evs)
+    report["mttr_s"] = round(first_step_t - death_wall, 2)
+    if check_shas:
+        commits = {e["step"]: e["sha"] for e in evs
+                   if e["evt"] == "commit"}
+        for s in (_TORN_STEP, _STEPS):
+            _check(commits.get(s) == check_shas.get(s),
+                   f"restart_resume: post-resume commit sha at step {s} "
+                   "diverged from the uninterrupted baseline")
+    print(f"cluster-selftest: restart_resume OK (resumed from step "
+          f"{_PERIOD}, MTTR {report['mttr_s']}s)")
+    return ckdir
+
+
+def phase_baseline_shas(nprocs, report):
+    """Uninterrupted 2-rank run: the reference {step: sha} trajectory."""
+    ckdir = tempfile.mkdtemp(prefix="mxnet_cluster_base_")
+    res = _launcher(nprocs, deadline_s=90.0).launch_python(
+        _TRAIN_WORKER, (ckdir, _STEPS, _PERIOD))
+    _no_reap(res, "baseline")
+    _check(res.ok, "baseline: " + res.describe())
+    shas = {e["step"]: e["sha"] for e in _events(res)
+            if e["evt"] == "commit"}
+    _check(sorted(shas) == [_PERIOD, _TORN_STEP, _STEPS],
+           f"baseline: commits at {sorted(shas)}")
+    print("cluster-selftest: baseline trajectory recorded "
+          f"(commits at {sorted(shas)})")
+    return shas
+
+
+def phase_hang_pre_barrier(nprocs, report):
+    """SIGSTOP (not death — a wedged rank): the survivor's barrier
+    timeout must fire and the supervisor must reap the frozen rank."""
+    victim = nprocs - 1
+    res = _launcher(nprocs, deadline_s=90.0,
+                    inject=f"hang@pre-barrier:{victim}@2").launch_python(
+        _BARRIER_WORKER)
+    _survivor_failed(res, victim, "hang_pre_barrier")
+    _check(victim in res.reaped_ranks,
+           f"hang_pre_barrier: frozen rank {victim} was not reaped "
+           f"({res.describe()})")
+    print("cluster-selftest: hang_pre_barrier OK (survivor aborted, "
+          "frozen rank reaped)")
+
+
+def phase_exit_mid_step(nprocs, report):
+    """Abrupt `os._exit(41)` mid-step: the survivor's in-flight
+    collective loses its peer and must become DistRankFailure, not a
+    hang."""
+    from .inject import EXIT_CODE
+    victim = nprocs - 1
+    ckdir = tempfile.mkdtemp(prefix="mxnet_cluster_exit_")
+    res = _launcher(nprocs, deadline_s=90.0,
+                    inject=f"exit@mid-step:{victim}@3").launch_python(
+        _TRAIN_WORKER, (ckdir, _STEPS, _PERIOD))
+    _check(res.returncodes[victim] == EXIT_CODE,
+           f"exit_mid_step: victim rc={res.returncodes[victim]}, "
+           f"expected {EXIT_CODE}")
+    _survivor_failed(res, victim, "exit_mid_step")
+    print("cluster-selftest: exit_mid_step OK")
+
+
+def phase_kill_pre_seal(nprocs, report, baseline_shas):
+    """SIGKILL rank 0 pre-seal: the coordination service dies with it;
+    survivors must still abort promptly, the torn step must not seal,
+    and a restart resumes from the last sealed commit."""
+    ckdir = tempfile.mkdtemp(prefix="mxnet_cluster_seal_")
+    args = (ckdir, _STEPS, _PERIOD)
+    res = _launcher(nprocs, deadline_s=90.0,
+                    inject="kill@pre-seal:0@2").launch_python(
+        _TRAIN_WORKER, args)
+    _check(res.returncodes[0] == -9,
+           f"kill_pre_seal: victim rc={res.returncodes[0]}")
+    _survivor_failed(res, 0, "kill_pre_seal")
+    from ..checkpoint import CheckpointManager
+    mgr = CheckpointManager(ckdir, keep_last_n=0)
+    _check(mgr.steps() == [_PERIOD],
+           f"kill_pre_seal: sealed steps {mgr.steps()}, expected "
+           f"[{_PERIOD}]")
+    mgr.close()
+    res2 = _launcher(nprocs, deadline_s=90.0).launch_python(
+        _TRAIN_WORKER, (*args, "resume"))
+    _no_reap(res2, "kill_pre_seal(2)")
+    _check(res2.ok, "kill_pre_seal: restarted run failed: "
+           + res2.describe())
+    commits = {e["step"]: e["sha"] for e in _events(res2)
+               if e["evt"] == "commit"}
+    _check(commits.get(_STEPS) == baseline_shas.get(_STEPS),
+           "kill_pre_seal: post-resume final commit sha diverged from "
+           "baseline")
+    print("cluster-selftest: kill_pre_seal OK (survived losing the "
+          "coordinator, resumed, sha matches baseline)")
+
+
+# -- entry points ------------------------------------------------------------
+
+def selftest(nprocs=2, matrix=False, bench=False):
+    if not cpu_collectives_available():
+        print(json.dumps({"metric": ("dist_recovery" if bench
+                                     else "cluster_selftest"),
+                          "ok": False,
+                          "skipped": "no CPU collectives backend "
+                                     "(gloo) in this jaxlib"}))
+        return 0            # can't run ≠ broken: report and step aside
+    t0 = time.time()
+    report = {"metric": "dist_recovery" if bench else "cluster_selftest",
+              "nprocs": nprocs}
+    try:
+        phase_barrier_roundtrip(nprocs, report)
+        phase_kill_pre_barrier(nprocs, report)
+        if matrix:
+            shas = phase_baseline_shas(nprocs, report)
+            phase_restart_resume(nprocs, report, check_shas=shas)
+            phase_hang_pre_barrier(nprocs, report)
+            phase_exit_mid_step(nprocs, report)
+            phase_kill_pre_seal(nprocs, report, shas)
+        else:
+            phase_restart_resume(nprocs, report)
+    except SelftestFailure as e:
+        report.update(ok=False, error=str(e))
+        print(json.dumps(report), flush=True)
+        return 1
+    report.update(ok=True, matrix=bool(matrix),
+                  elapsed_s=round(time.time() - t0, 1))
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+def run_command(nprocs, deadline_s, command):
+    """Launch/supervise an arbitrary command across a localhost gang."""
+    # the launcher scrubs MXNET_CLUSTER_INJECT from rank env unless armed
+    # explicitly; honor the operator's env spec on the CLI path
+    launcher = ClusterLauncher(nprocs=nprocs, deadline_s=deadline_s,
+                               inject=os.environ.get("MXNET_CLUSTER_INJECT"))
+    res = launcher.launch(command)
+    print(f"cluster: {res.describe()}", file=sys.stderr)
+    if res.ok:
+        return 0
+    return next((rc for rc in res.returncodes if rc not in (0, None)), 1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.cluster",
+        description="multi-process launch/supervise/fault-inject harness")
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--matrix", action="store_true",
+                    help="full injection matrix incl. sha-identity proofs")
+    ap.add_argument("--bench", action="store_true",
+                    help="selftest emitting the dist_recovery JSON line")
+    ap.add_argument("-n", "--nprocs", type=int,
+                    default=int(os.environ.get("MXNET_CLUSTER_NPROCS",
+                                               "2")))
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="wall-clock budget for launched commands")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.selftest or args.bench:
+        return selftest(nprocs=max(2, args.nprocs), matrix=args.matrix,
+                        bench=args.bench)
+    if not args.command:
+        ap.error("no command given (or pass --selftest)")
+    return run_command(args.nprocs, args.deadline, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Fault-injection plane for the cluster harness.
+
+`MXNET_CLUSTER_INJECT=<kill|hang|exit>@<point>[:rank][@<n>]` arms ONE
+named injection point (the `MXNET_CHECKPOINT_INJECT_CRASH=<point>@<step>`
+idiom generalized to the multi-process runtime): when the `n`-th hit of
+`<point>` lands on the selected rank, the process is SIGKILLed (`kill`),
+SIGSTOPped (`hang` — the process stays alive but silent, the shape of a
+wedged NIC or a GIL-stuck rank), or `os._exit(41)`s (`exit`).
+Omitting `:rank` fires on every rank; omitting `@<n>` fires on the first
+hit. The spec is parsed per call straight from the environment — a dict
+lookup when unarmed — so workers can arm/disarm dynamically and the
+launcher can arm a single rank by env alone.
+
+Injection points (docs/CLUSTER.md carries the table):
+
+  pre-barrier / post-barrier   dist.barrier entry / exit
+  mid-step                     dist.allreduce_sum, before the collective
+                               (the kvstore push gradient reduce)
+  pre-commit                   cooperative checkpoint commit entry
+  mid-cooperative-commit       after this rank wrote its owned shards,
+                               before the all-shards barrier
+  pre-seal                     rank 0 only: all shards on disk, before
+                               the TOPOLOGY.json seal
+
+This module must stay import-light (no jax, no mxnet_tpu package hooks):
+dist.py and checkpoint/manager.py import it inside hot functions.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+__all__ = ["INJECTION_POINTS", "ACTIONS", "ENV_VAR", "InjectSpec",
+           "parse_spec", "current_rank", "maybe_inject", "reset_counters"]
+
+ENV_VAR = "MXNET_CLUSTER_INJECT"
+
+INJECTION_POINTS = {
+    "pre-barrier": "dist.barrier entry, before the rendezvous",
+    "post-barrier": "dist.barrier exit, after the rendezvous",
+    "mid-step": "dist.allreduce_sum before the cross-process reduce "
+                "(the kvstore push path)",
+    "pre-commit": "cooperative checkpoint commit entry, before staging",
+    "mid-cooperative-commit": "own shards written, before the "
+                              "all-shards barrier",
+    "pre-seal": "rank 0 only: every shard on disk, before the "
+                "TOPOLOGY.json seal",
+}
+
+ACTIONS = ("kill", "hang", "exit")
+
+EXIT_CODE = 41          # the `exit` action's recognizable status
+
+_lock = threading.Lock()
+_hits = {}              # point -> hit count (this process)
+_fired = set()          # points whose action already ran (`exit` may be
+                        # caught upstream; never fire twice)
+
+
+class InjectSpec:
+    """Parsed `<action>@<point>[:rank][@<n>]`."""
+
+    __slots__ = ("action", "point", "rank", "nth")
+
+    def __init__(self, action, point, rank=None, nth=1):
+        self.action = action
+        self.point = point
+        self.rank = rank
+        self.nth = nth
+
+    def __repr__(self):
+        r = "" if self.rank is None else f":{self.rank}"
+        n = "" if self.nth == 1 else f"@{self.nth}"
+        return f"{self.action}@{self.point}{r}{n}"
+
+
+def parse_spec(spec):
+    """Parse an injection spec string; raises ValueError on malformed
+    input (unknown action/point, non-integer rank/nth)."""
+    spec = str(spec).strip()
+    action, sep, rest = spec.partition("@")
+    if not sep or action not in ACTIONS:
+        raise ValueError(
+            f"{ENV_VAR}: want <kill|hang|exit>@<point>[:rank][@<n>], "
+            f"got {spec!r}")
+    point, sep, nth_s = rest.partition("@")
+    nth = 1
+    if sep:
+        try:
+            nth = int(nth_s)
+        except ValueError:
+            raise ValueError(f"{ENV_VAR}: hit index {nth_s!r} not an int")
+        if nth < 1:
+            raise ValueError(f"{ENV_VAR}: hit index must be >= 1")
+    point, sep, rank_s = point.partition(":")
+    rank = None
+    if sep:
+        try:
+            rank = int(rank_s)
+        except ValueError:
+            raise ValueError(f"{ENV_VAR}: rank {rank_s!r} not an int")
+    if point not in INJECTION_POINTS:
+        raise ValueError(
+            f"{ENV_VAR}: unknown point {point!r} "
+            f"(known: {', '.join(sorted(INJECTION_POINTS))})")
+    return InjectSpec(action, point, rank, nth)
+
+
+def current_rank():
+    """This process's rank per the DMLC env contract (the launcher always
+    exports DMLC_WORKER_ID; 0 outside a launched gang)."""
+    try:
+        return int(os.environ.get("DMLC_WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def reset_counters():
+    """Forget hit counts (tests that parse/fire in-process repeatedly)."""
+    with _lock:
+        _hits.clear()
+        _fired.clear()
+
+
+def _fire(spec, point):
+    sys.stderr.write(
+        f"[cluster-inject] firing {spec.action}@{point} "
+        f"rank {current_rank()} pid {os.getpid()}\n")
+    sys.stderr.flush()
+    sys.stdout.flush()
+    if spec.action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif spec.action == "hang":
+        os.kill(os.getpid(), signal.SIGSTOP)    # frozen until SIGCONT/KILL
+    else:                                       # exit
+        # os._exit, not SystemExit: interpreter teardown would try to
+        # shut the jax distributed client down against peers that are
+        # NOT exiting and block — the simulated crash must be prompt
+        os._exit(EXIT_CODE)
+    return True
+
+
+def maybe_inject(point):
+    """Hot-path hook: fire the armed action if `point` matches the
+    MXNET_CLUSTER_INJECT spec on this rank's n-th hit. Returns True when
+    a non-fatal action (hang, resumed later) fired, False otherwise.
+    Cost when unarmed: one os.environ lookup."""
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return False
+    try:
+        spec = parse_spec(raw)
+    except ValueError as e:
+        sys.stderr.write(f"[cluster-inject] ignoring bad spec: {e}\n")
+        return False
+    if point != spec.point:
+        return False
+    if spec.rank is not None and current_rank() != spec.rank:
+        return False
+    with _lock:
+        if point in _fired:
+            return False
+        _hits[point] = _hits.get(point, 0) + 1
+        if _hits[point] != spec.nth:
+            return False
+        _fired.add(point)
+    return _fire(spec, point)

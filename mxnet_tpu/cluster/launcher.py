@@ -1,0 +1,319 @@
+"""Multi-process launcher/supervisor — the testable pod.
+
+Spawns N real Python processes joined into one `jax.distributed` job
+over localhost TCP (coordinator port auto-picked, the DMLC_* env
+contract tools/launch.py already exports) and SUPERVISES them: per-rank
+log streaming with `[rN]` prefixes, a wall-clock deadline that reaps the
+whole tree, and a failure grace window — when any rank dies, survivors
+get `failure_grace_s` to detect it themselves (dist.py's timeout
+barriers turn the silence into a named `DistRankFailure`) before the
+supervisor SIGKILLs whatever is left, stopped ranks included.
+
+Each rank is pinned to its own virtual CPU device set
+(`JAX_NUM_CPU_DEVICES` + `--xla_force_host_platform_device_count`, the
+PR 8 elastic-selftest idiom) and gets the Gloo cross-process CPU
+collectives backend (`JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo`) —
+without it the CPU backend refuses multi-process computations, which is
+why the three seed-era `tests/test_dist_*` suites never ran their
+multi-rank path.
+
+Concurrency surfaces (analysis/locklint contract): each rank's log pump
+is one daemon thread appending to that rank's own deque (GIL-atomic
+appends, single writer) and to the shared stream under `_stream_lock`;
+the supervisor loop only ever reads. No other cross-thread state.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+__all__ = ["ClusterLauncher", "ClusterResult", "RankProc", "free_port",
+           "cpu_collectives_available"]
+
+# analysis/locklint: RankProc.tail is a deque with exactly one writer
+# (that rank's pump thread; appends are GIL-atomic) and read-only after
+# the pump joins; ClusterResult fields are written before the result is
+# published. Declared lock-free by design.
+__analysis_thread_safe__ = {"RankProc.tail", "RankProc.exit_rc",
+                            "RankProc.exit_t"}
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cpu_collectives_available():
+    """True when this jaxlib can run cross-process collectives on the
+    CPU backend (the Gloo TCP transport is compiled in). The dist tests
+    skip-with-reason instead of failing when it is absent."""
+    try:
+        from jax._src.lib import xla_client
+        return hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+    except Exception:
+        return False
+
+
+class RankProc:
+    """One supervised rank: the Popen handle, its log tail, exit record."""
+
+    def __init__(self, rank, proc, tail_lines):
+        self.rank = rank
+        self.proc = proc
+        self.tail = collections.deque(maxlen=tail_lines)
+        self.exit_rc = None         # set once by the supervisor loop
+        self.exit_t = None
+        self.reaped = False
+
+    def log_text(self):
+        return "".join(self.tail)
+
+
+class ClusterResult:
+    """What one launch() observed. `ok` iff every rank exited 0 with no
+    reaping and no deadline; timing fields feed the bench lane."""
+
+    def __init__(self, ranks, elapsed_s, deadline_fired, first_death_t,
+                 t0):
+        self.returncodes = [rp.exit_rc for rp in ranks]
+        self.elapsed_s = elapsed_s
+        self.deadline_fired = deadline_fired
+        self.reaped_ranks = [rp.rank for rp in ranks if rp.reaped]
+        self.failed_ranks = [rp.rank for rp in ranks
+                             if rp.exit_rc not in (0, None)]
+        # seconds-from-launch timeline (None when no rank died)
+        self.first_death_s = (None if first_death_t is None
+                              else first_death_t - t0)
+        self.exit_s = [None if rp.exit_t is None else rp.exit_t - t0
+                       for rp in ranks]
+        self.tails = {rp.rank: rp.log_text() for rp in ranks}
+
+    @property
+    def ok(self):
+        return (not self.deadline_fired and not self.reaped_ranks
+                and all(rc == 0 for rc in self.returncodes))
+
+    def describe(self):
+        return (f"rcs={self.returncodes} reaped={self.reaped_ranks} "
+                f"deadline_fired={self.deadline_fired} "
+                f"elapsed={self.elapsed_s:.1f}s")
+
+
+class ClusterLauncher:
+    """Launch + supervise an N-rank localhost gang.
+
+    Parameters
+    ----------
+    nprocs : gang size (default MXNET_CLUSTER_NPROCS, 2)
+    devices_per_rank : virtual CPU devices pinned per rank (default 1)
+    deadline_s : wall-clock budget; past it the whole tree is SIGKILLed
+        and `deadline_fired` is set (default 120)
+    failure_grace_s : after the first rank exits, how long the remaining
+        ranks get to finish on their own before the supervisor reaps
+        them (default: MXNET_DIST_TIMEOUT_S * (retries+1) + 15 — enough
+        for every survivor's barrier timeout to fire and name the dead)
+    dist_timeout_s / dist_retries : exported to the ranks as
+        MXNET_DIST_TIMEOUT_S / MXNET_DIST_RETRIES when given
+    inject : MXNET_CLUSTER_INJECT spec exported to every rank (the spec
+        itself selects the victim rank)
+    env : extra env vars for every rank
+    stream : echo per-rank output with `[rN] ` prefixes (always captured
+        in the per-rank tail either way)
+    """
+
+    def __init__(self, nprocs=None, devices_per_rank=1, deadline_s=120.0,
+                 failure_grace_s=None, dist_timeout_s=None,
+                 dist_retries=None, inject=None, env=None, stream=True,
+                 tail_lines=500, python=None):
+        if nprocs is None:
+            try:
+                nprocs = int(os.environ.get("MXNET_CLUSTER_NPROCS", "2"))
+            except ValueError:
+                nprocs = 2
+        self.nprocs = max(1, int(nprocs))
+        self.devices_per_rank = max(1, int(devices_per_rank))
+        self.deadline_s = float(deadline_s)
+        self.dist_timeout_s = dist_timeout_s
+        self.dist_retries = dist_retries
+        if failure_grace_s is None:
+            t = float(dist_timeout_s if dist_timeout_s is not None
+                      else os.environ.get("MXNET_DIST_TIMEOUT_S") or 60.0)
+            r = int(dist_retries if dist_retries is not None
+                    else os.environ.get("MXNET_DIST_RETRIES") or 1)
+            failure_grace_s = t * (r + 1) + 15.0
+        self.failure_grace_s = float(failure_grace_s)
+        self.inject = inject
+        self.env = dict(env or {})
+        self.stream = stream
+        self.tail_lines = int(tail_lines)
+        self.python = python or sys.executable
+        self._stream_lock = threading.Lock()
+
+    # -- environment ---------------------------------------------------------
+
+    def rank_env(self, rank, port):
+        """The env one rank runs under: DMLC_* contract + per-rank CPU
+        device pin + the Gloo CPU-collectives backend."""
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(self.nprocs),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        d = self.devices_per_rank
+        env["JAX_NUM_CPU_DEVICES"] = str(d)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={d}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+        if self.dist_timeout_s is not None:
+            env["MXNET_DIST_TIMEOUT_S"] = str(self.dist_timeout_s)
+        if self.dist_retries is not None:
+            env["MXNET_DIST_RETRIES"] = str(self.dist_retries)
+        if self.inject:
+            env["MXNET_CLUSTER_INJECT"] = str(self.inject)
+        else:
+            env.pop("MXNET_CLUSTER_INJECT", None)
+        return env
+
+    # -- launch / supervise --------------------------------------------------
+
+    def launch(self, argv):
+        """Run `argv` (a full command list) as every rank; supervise to
+        completion. Returns a ClusterResult; never raises on rank
+        failure (the result carries the verdict)."""
+        port = free_port()
+        ranks = []
+        t0 = time.monotonic()
+        try:
+            for r in range(self.nprocs):
+                proc = subprocess.Popen(
+                    list(argv), env=self.rank_env(r, port),
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, errors="replace",
+                    start_new_session=True)     # own pgid: killpg reaps
+                ranks.append(RankProc(r, proc, self.tail_lines))
+        except Exception:
+            for rp in ranks:
+                self._kill_tree(rp)
+            raise
+        pumps = [threading.Thread(target=self._pump, args=(rp,),
+                                  name=f"cluster-log-r{rp.rank}",
+                                  daemon=True) for rp in ranks]
+        for p in pumps:
+            p.start()
+        deadline_fired = False
+        first_exit_t = None
+        first_death_t = None
+        while True:
+            alive = 0
+            now = time.monotonic()
+            for rp in ranks:
+                if rp.exit_rc is None:
+                    rc = rp.proc.poll()
+                    if rc is None:
+                        alive += 1
+                    else:
+                        rp.exit_rc = rc
+                        rp.exit_t = now
+                        if first_exit_t is None:
+                            first_exit_t = now
+                        if rc != 0 and first_death_t is None:
+                            first_death_t = now
+            if not alive:
+                break
+            if now - t0 > self.deadline_s:
+                # the harness's last line of defense; the selftest matrix
+                # asserts this never fires (survivors always self-abort
+                # through the dist timeout first)
+                deadline_fired = True
+                self._emit("cluster: DEADLINE after "
+                           f"{self.deadline_s:.0f}s — reaping "
+                           f"{alive} live rank(s)\n")
+                self._reap_live(ranks)
+                break
+            if (first_exit_t is not None
+                    and now - first_exit_t > self.failure_grace_s):
+                self._emit("cluster: rank(s) still running "
+                           f"{self.failure_grace_s:.0f}s after the first "
+                           "exit — reaping\n")
+                self._reap_live(ranks)
+                break
+            time.sleep(0.05)
+        now = time.monotonic()
+        for rp in ranks:                    # collect post-reap statuses
+            if rp.exit_rc is None:
+                try:
+                    rp.exit_rc = rp.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:   # pragma: no cover
+                    rp.exit_rc = -signal.SIGKILL
+                rp.exit_t = now
+                if rp.exit_rc != 0 and first_death_t is None:
+                    first_death_t = now
+        for p in pumps:
+            p.join(timeout=5)
+        return ClusterResult(ranks, time.monotonic() - t0,
+                             deadline_fired, first_death_t, t0)
+
+    def launch_python(self, source, args=(), workdir=None):
+        """Write `source` to a worker script and launch it on every rank
+        (the subprocess-worker idiom the dist tests already use)."""
+        wd = workdir or tempfile.mkdtemp(prefix="mxnet_cluster_")
+        script = os.path.join(wd, "cluster_worker.py")
+        with open(script, "w", encoding="utf-8") as f:
+            f.write(source)
+        return self.launch([self.python, script, *map(str, args)])
+
+    # -- internals -----------------------------------------------------------
+
+    def _pump(self, rp):
+        try:
+            for line in rp.proc.stdout:
+                rp.tail.append(line)
+                if self.stream:
+                    self._emit(f"[r{rp.rank}] {line}")
+        except ValueError:                  # pragma: no cover - closed fd
+            pass
+        finally:
+            try:
+                rp.proc.stdout.close()
+            except OSError:                 # pragma: no cover
+                pass
+
+    def _emit(self, text):
+        with self._stream_lock:
+            sys.stdout.write(text)
+            sys.stdout.flush()
+
+    def _reap_live(self, ranks):
+        for rp in ranks:
+            if rp.proc.poll() is None:
+                rp.reaped = True
+                self._kill_tree(rp)
+
+    @staticmethod
+    def _kill_tree(rp):
+        """SIGKILL the rank's whole process group (start_new_session made
+        it a group leader); SIGKILL lands on SIGSTOPped ranks too."""
+        try:
+            os.killpg(os.getpgid(rp.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                rp.proc.kill()
+            except OSError:                 # pragma: no cover
+                pass

@@ -117,6 +117,21 @@ _DOCUMENTED = {
     "MXNET_ZERO_STAGE": 0,
     "MXNET_ZERO_BUCKET_MB": "4",
     "MXNET_GRAD_COMPRESS": "none",
+    # multi-process cluster harness + distributed-runtime hardening
+    # (mxnet_tpu.cluster + dist.py, docs/CLUSTER.md):
+    # MXNET_DIST_TIMEOUT_S (float-string seconds) bounds every
+    # dist.barrier()/collective wait — past it the runtime dumps
+    # all-thread stacks and raises DistRankFailure naming the missing
+    # rank(s); MXNET_DIST_RETRIES re-waits a timed-out barrier with
+    # exponential backoff first (transient stragglers; all surviving
+    # ranks retry in lockstep); MXNET_CLUSTER_NPROCS is the launcher's
+    # default gang size; MXNET_CLUSTER_INJECT=
+    # <kill|hang|exit>@<point>[:rank][@<n>] arms the fault-injection
+    # plane (selftests/CI only — see the point table in docs/CLUSTER.md)
+    "MXNET_DIST_TIMEOUT_S": "60",
+    "MXNET_DIST_RETRIES": 1,
+    "MXNET_CLUSTER_NPROCS": 2,
+    "MXNET_CLUSTER_INJECT": None,
     # static analysis (mxnet_tpu.analysis, docs/ANALYSIS.md):
     # MXNET_ANALYSIS_BASELINE=<path> points the finding-suppression
     # baseline somewhere other than tools/analysis_baseline.json;

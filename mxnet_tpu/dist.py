@@ -15,18 +15,140 @@ port unchanged):
   DMLC_NUM_WORKER                      -> num_processes
   DMLC_WORKER_ID                       -> process_id
 jax-native MXNET_COORDINATOR ("host:port") is also accepted.
+
+Failure model (docs/CLUSTER.md): every rendezvous — `barrier()`, the
+host collectives, and through them the cooperative checkpoint commit —
+is bounded by MXNET_DIST_TIMEOUT_S (default 60s). A barrier that times
+out is retried up to MXNET_DIST_RETRIES times with exponential backoff
+(transient stragglers; the coordination service fails a timed-out
+barrier for EVERY participant, so all ranks retry in lockstep). Past the
+retries the runtime dumps all-thread stacks through the telemetry
+watchdog, posts an abort key so peer ranks stop waiting out their own
+full timeouts, and raises `DistRankFailure` naming the missing rank(s).
+While any wait is in flight this thread beats the stall watchdog (a
+rendezvous is liveness, not a hang) and slow (>5s) barriers are logged
+with name + elapsed, visible at /metrics (`mxnet_dist_barrier_wait_us`)
+and in the JSONL steplog before any timeout fires.
 """
 from __future__ import annotations
 
+import logging
 import os
+import re
+import threading
+import time
 
 from .base import MXNetError
 
+__all__ = ["DistRankFailure", "RANK_FAILURE_EXIT", "init_process_group",
+           "is_initialized", "allreduce_sum", "broadcast_from_root",
+           "barrier"]
+
+logger = logging.getLogger("mxnet_tpu.dist")
+
 _initialized = False
+
+_SLOW_BARRIER_S = 5.0
+_ABORT_DIR = "mxnet_tpu/abort/"
+
+# analysis/locklint: _barrier_seq is only ever mutated under _seq_lock;
+# the guarded-thread result boxes are function-local. _initialized is a
+# single-writer main-thread flag (set once in init_process_group before
+# any guarded thread exists; GIL-atomic bool reads elsewhere).
+__analysis_thread_safe__ = {"_initialized"}
+
+_barrier_seq = {}           # barrier name -> calls so far (id uniquifier)
+_seq_lock = threading.Lock()
+
+
+class DistRankFailure(MXNetError):
+    """A peer rank died or wedged: a distributed rendezvous exceeded
+    MXNET_DIST_TIMEOUT_S (or the coordinator vanished). `missing_ranks`
+    names the ranks that never arrived when the coordination service
+    could tell; all-thread stacks were dumped before raising."""
+
+    def __init__(self, message, barrier=None, missing_ranks=()):
+        super().__init__(message)
+        self.barrier = barrier
+        self.missing_ranks = tuple(missing_ranks)
 
 
 def is_initialized():
     return _initialized
+
+
+RANK_FAILURE_EXIT = 43      # rc of a rank that died OF a peer's death
+
+
+def _install_failfast_excepthook():
+    """An uncaught DistRankFailure must end the process NOW. The jax
+    distributed client/service teardown rendezvouses with peers at
+    interpreter exit, and the peer this failure is ABOUT is dead — a
+    normal `raise`-to-exit turns a detected failure into a teardown
+    hang the supervisor has to reap (observed: grace-reap at 20s for a
+    failure detected at 5s). So once the traceback is printed, flush
+    and `os._exit(RANK_FAILURE_EXIT)`. Callers that catch
+    DistRankFailure in-process are unaffected."""
+    import sys
+    if getattr(sys.excepthook, "_mxnet_dist_failfast", False):
+        return
+    prev = sys.excepthook
+
+    def hook(tp, val, tb):
+        prev(tp, val, tb)
+        if isinstance(val, DistRankFailure):
+            try:
+                sys.stdout.flush()
+                sys.stderr.flush()
+            except Exception:               # pragma: no cover
+                pass
+            os._exit(RANK_FAILURE_EXIT)
+
+    hook._mxnet_dist_failfast = True
+    sys.excepthook = hook
+
+
+def _timeout_s(override=None):
+    if override is not None:
+        return float(override)
+    try:
+        from . import config
+        return float(config.get("MXNET_DIST_TIMEOUT_S") or 60.0)
+    except Exception:                       # pragma: no cover
+        return 60.0
+
+
+def _retries(override=None):
+    if override is not None:
+        return max(0, int(override))
+    try:
+        from . import config
+        return max(0, int(config.get("MXNET_DIST_RETRIES")))
+    except Exception:                       # pragma: no cover
+        return 1
+
+
+def _enable_cpu_collectives():
+    """CPU hosts need a cross-process collectives transport: jax's cpu
+    client defaults to `none` and then refuses multi-process
+    computations outright. Pick Gloo unless the user configured a
+    different one. The JAX_CPU_COLLECTIVES_IMPLEMENTATION env spelling
+    is honored here explicitly — this jax version's config flag does NOT
+    read it on its own."""
+    import jax
+    try:
+        # a command-line Flag, not a config attribute, in this jax —
+        # readable only through its holder; update() still works
+        from jax._src import xla_bridge
+        current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except Exception:                       # option absent in this jax
+        return
+    want = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION") or "gloo"
+    if current in (None, "none") or current != want:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", want)
+        except Exception:                   # pragma: no cover
+            pass
 
 
 def init_process_group(coordinator_address=None, num_processes=None,
@@ -55,6 +177,7 @@ def init_process_group(coordinator_address=None, num_processes=None,
             "DMLC_PS_ROOT_PORT (launch via tools/launch.py) or "
             "MXNET_COORDINATOR=host:port")
     import jax
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
@@ -66,8 +189,190 @@ def init_process_group(coordinator_address=None, num_processes=None,
             "this) instead of creating the dist kvstore late: " + str(e)
         ) from e
     _initialized = True
+    _install_failfast_excepthook()
+    try:
+        # every /metrics sample and absorbed counter from this process
+        # carries its rank from here on
+        from .telemetry import get_registry
+        get_registry().set_constant_labels({"rank": str(process_id)})
+    except Exception:                       # pragma: no cover
+        pass
     return True
 
+
+# -- coordination-service plumbing -------------------------------------------
+
+def _client():
+    """The jax coordination-service client (KV store + named barriers);
+    None when unavailable (not initialized, or a jax without the
+    internal handle — everything degrades to the plain collectives)."""
+    if not _initialized:
+        return None
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client
+    except Exception:                       # pragma: no cover
+        return None
+
+
+def _rank():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:                       # pragma: no cover
+        return int(os.environ.get("DMLC_WORKER_ID", "0"))
+
+
+def _post_abort(reason):
+    """Publish this rank's failure so peers abort promptly instead of
+    waiting out their own full timeouts (coordinated abort)."""
+    c = _client()
+    if c is None:
+        return
+    try:
+        c.key_value_set(f"{_ABORT_DIR}rank_{_rank()}", str(reason)[:512])
+    except Exception:                       # key exists / service gone
+        pass
+
+
+def _peer_abort():
+    """(rank_key, reason) of any published peer abort, else None."""
+    c = _client()
+    if c is None:
+        return None
+    try:
+        entries = c.key_value_dir_get(_ABORT_DIR)
+    except Exception:                       # empty dir raises NOT_FOUND
+        return None
+    for k, v in entries or []:
+        return (k, v)
+    return None
+
+
+def _parse_missing(msg):
+    """Rank numbers out of a coordination-service DEADLINE_EXCEEDED
+    message ("Some timed out task names:\\n/job:.../task:1")."""
+    tail = msg.split("task names:")[-1]
+    return sorted({int(m) for m in re.findall(r"/task:(\d+)", tail)})
+
+
+def _metrics():
+    from .telemetry import counter
+    return (counter("mxnet_dist_barrier_wait_us",
+                    help="cumulative microseconds spent waiting in "
+                         "dist barriers/collectives"),
+            counter("mxnet_dist_rank_failures_total",
+                    help="DistRankFailure raised (timed-out rendezvous "
+                         "or coordinated abort)"))
+
+
+def _log_event(event, **fields):
+    try:
+        from .telemetry.steplog import log_event
+        log_event(event, **fields)
+    except Exception:                       # pragma: no cover
+        pass
+
+
+def _fail(what, missing, reason, elapsed_s):
+    """The one exit ramp for a dead rendezvous: coordinated abort key,
+    all-thread stack dump, failure counter, JSONL record, raise."""
+    _post_abort(f"{what}: {reason}")
+    try:
+        from .telemetry import watchdog
+        watchdog.dump_now(reason=f"dist {what} failed: {reason}")
+    except Exception:                       # pragma: no cover
+        pass
+    _, c_fail = _metrics()
+    c_fail.inc()
+    _log_event("dist_rank_failure", what=what,
+               missing_ranks=list(missing), reason=str(reason)[:300],
+               elapsed_s=round(elapsed_s, 3))
+    named = (f" — missing rank(s): {', '.join(map(str, missing))}"
+             if missing else "")
+    raise DistRankFailure(
+        f"distributed {what} failed after {elapsed_s:.1f}s: "
+        f"{reason}{named}", barrier=what, missing_ranks=missing)
+
+
+def _classify(exc):
+    """(is_rank_failure, missing, reason) for a collective/barrier
+    exception."""
+    txt = str(exc)
+    first = txt.splitlines()[0][:300] if txt else repr(exc)
+    if "DEADLINE_EXCEEDED" in txt or "Barrier timed out" in txt:
+        return True, _parse_missing(txt), first
+    low = txt.lower()
+    if "connection closed by peer" in low:      # Gloo mid-collective
+        return True, [], f"peer socket closed mid-collective ({first})"
+    if ("UNAVAILABLE" in txt or "failed to connect" in low
+            or "connection reset" in low
+            or "Connection refused" in txt):
+        # the coordination service lives in rank 0's process: losing the
+        # channel usually means rank 0 itself is gone
+        return True, [], f"coordinator unreachable ({first})"
+    return False, [], first
+
+
+def _run_guarded(fn, what, timeout_s):
+    """Run a blocking rendezvous on a side thread under a deadline: this
+    thread beats the stall watchdog (waiting is liveness, not a hang),
+    polls for peer abort keys, logs slow (>5s) waits, and converts a
+    blown deadline or a transport error into DistRankFailure instead of
+    a forever-block. Returns fn()'s value."""
+    from .telemetry import watchdog
+    box = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:          # noqa: BLE001 - reraised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=run, name=f"dist-{what}"[:30],
+                          daemon=True)
+    th.start()
+    warned_slow = False
+    while not done.wait(0.25):
+        elapsed = time.monotonic() - t0
+        watchdog.beat(f"dist wait {what}")
+        if not warned_slow and elapsed > _SLOW_BARRIER_S:
+            warned_slow = True
+            logger.warning("dist %s slow: %.1fs and still waiting "
+                           "(timeout %.1fs)", what, elapsed, timeout_s)
+            _log_event("dist_barrier_slow", what=what,
+                       elapsed_s=round(elapsed, 3),
+                       timeout_s=timeout_s)
+        ab = _peer_abort()
+        if ab is not None:
+            _fail(what, [], f"peer abort: {ab[0]} ({ab[1]})", elapsed)
+        if elapsed > timeout_s:
+            _fail(what, [], f"no progress after {timeout_s:.1f}s "
+                            "(rendezvous still blocked)", elapsed)
+    elapsed = time.monotonic() - t0
+    if "error" in box:
+        e = box["error"]
+        if isinstance(e, DistRankFailure):
+            raise e
+        is_rank, missing, reason = _classify(e)
+        if is_rank:
+            _fail(what, missing, reason, elapsed)
+        raise e
+    c_wait, _ = _metrics()
+    c_wait.inc(int(elapsed * 1e6))
+    if elapsed > _SLOW_BARRIER_S:
+        logger.warning("dist %s completed after %.1fs (slow)", what,
+                       elapsed)
+        _log_event("dist_barrier_slow", what=what, done=True,
+                   elapsed_s=round(elapsed, 3), timeout_s=timeout_s)
+    return box.get("value")
+
+
+# -- collectives -------------------------------------------------------------
 
 def allreduce_sum(values, reduce_dtype=None):
     """Sum a host-local numpy/jax array across all processes.
@@ -85,10 +390,14 @@ def allreduce_sum(values, reduce_dtype=None):
     import jax
     if jax.process_count() == 1:
         return values
+    from .cluster import inject
+    inject.maybe_inject("mid-step")
     from jax.experimental import multihost_utils
     if reduce_dtype is not None:
         values = np.asarray(values).astype(reduce_dtype)
-    gathered = _local_value(multihost_utils.process_allgather(values))
+    gathered = _run_guarded(
+        lambda: _local_value(multihost_utils.process_allgather(values)),
+        "allreduce", _timeout_s())
     if reduce_dtype is not None:
         return gathered.astype(np.float32).sum(axis=0)
     return gathered.sum(axis=0)
@@ -110,12 +419,53 @@ def broadcast_from_root(values):
     if jax.process_count() == 1:
         return values
     from jax.experimental import multihost_utils
-    return _local_value(multihost_utils.broadcast_one_to_all(values))
+    return _run_guarded(
+        lambda: _local_value(multihost_utils.broadcast_one_to_all(values)),
+        "broadcast", _timeout_s())
 
 
-def barrier(name="kvstore"):
+def barrier(name="kvstore", timeout_s=None, retries=None):
+    """All processes rendezvous; none proceeds until every one arrives —
+    or `timeout_s` (MXNET_DIST_TIMEOUT_S) passes, after which the wait
+    is retried `retries` (MXNET_DIST_RETRIES) times with exponential
+    backoff and then fails as DistRankFailure naming the missing ranks.
+    The coordination service fails a timed-out barrier for EVERY
+    participant, so retries stay in lockstep across surviving ranks."""
     import jax
     if jax.process_count() == 1:
         return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    from .cluster import inject
+    inject.maybe_inject("pre-barrier")
+    timeout = _timeout_s(timeout_s)
+    tries = _retries(retries)
+    client = _client()
+    if client is None:
+        # no coordination handle: plain device sync, still deadline-bound
+        from jax.experimental import multihost_utils
+        _run_guarded(lambda: multihost_utils.sync_global_devices(name),
+                     f"barrier {name!r}", timeout)
+        inject.maybe_inject("post-barrier")
+        return
+    with _seq_lock:
+        seq = _barrier_seq[name] = _barrier_seq.get(name, 0) + 1
+    base_id = f"mx::{name}::{seq}"          # ids are one-shot in the
+    t0 = time.monotonic()                   # coordination service
+    for attempt in range(tries + 1):
+        bid = base_id if attempt == 0 else f"{base_id}::r{attempt}"
+        try:
+            _run_guarded(
+                lambda b=bid: client.wait_at_barrier(
+                    b, timeout_in_ms=int(timeout * 1000)),
+                f"barrier {name!r}", timeout + 5.0)
+            break
+        except DistRankFailure:
+            elapsed = time.monotonic() - t0
+            if attempt >= tries:
+                raise
+            backoff = min(0.25 * (2 ** attempt), 5.0)
+            logger.warning(
+                "dist barrier %r timed out (attempt %d/%d, %.1fs); "
+                "retrying in %.2fs", name, attempt + 1, tries + 1,
+                elapsed, backoff)
+            time.sleep(backoff)
+    inject.maybe_inject("post-barrier")

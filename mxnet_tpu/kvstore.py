@@ -134,8 +134,17 @@ class KVStore:
                 from . import amp as _amp
                 from . import dist
                 from .ndarray.ndarray import array as nd_array
-                summed = dist.allreduce_sum(
-                    merged.asnumpy(), reduce_dtype=_amp.reduce_dtype())
+                try:
+                    summed = dist.allreduce_sum(
+                        merged.asnumpy(), reduce_dtype=_amp.reduce_dtype())
+                except dist.DistRankFailure as e:
+                    # name the key whose reduce lost its peers — the
+                    # stack dump is already on record (dist.py dumped
+                    # before raising)
+                    raise dist.DistRankFailure(
+                        f"dist push of key {k!r} failed: {e}",
+                        barrier=e.barrier,
+                        missing_ranks=e.missing_ranks) from e
                 merged = nd_array(summed, ctx=merged.context)
             stored = self._store[k]
             if self._updater is not None:

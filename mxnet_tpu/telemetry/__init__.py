@@ -31,11 +31,11 @@ from __future__ import annotations
 from .registry import (Counter, Gauge, Histogram, Registry, counter, gauge,
                        get_registry, histogram)
 from .exporter import TelemetryServer, get_server, start_server, stop_server
-from .steplog import StepLogger, enabled, maybe_step_logger
+from .steplog import StepLogger, enabled, log_event, maybe_step_logger
 from . import watchdog
 from .watchdog import install as install_watchdog
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "counter", "gauge",
            "histogram", "get_registry", "TelemetryServer", "start_server",
            "stop_server", "get_server", "StepLogger", "maybe_step_logger",
-           "enabled", "watchdog", "install_watchdog"]
+           "enabled", "log_event", "watchdog", "install_watchdog"]

@@ -206,6 +206,23 @@ def _fmt(v):
     return repr(v)
 
 
+def _with_labels(line, labels):
+    """Merge constant labels into one exposition sample line (comment
+    lines pass through; existing labels like histogram `le` keep their
+    place after the constants)."""
+    if not line or line.startswith("#"):
+        return line
+    name, sep, value = line.partition(" ")
+    if not sep:                             # pragma: no cover - malformed
+        return line
+    pairs = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    if "{" in name:
+        name = name.replace("{", "{" + pairs + ",", 1)
+    else:
+        name = f"{name}{{{pairs}}}"
+    return f"{name} {value}"
+
+
 class Registry:
     """Name -> metric store. `counter/gauge/histogram` are get-or-create
     (same name + same kind returns the existing instance, so any module
@@ -215,6 +232,25 @@ class Registry:
         self._lock = threading.Lock()
         self._metrics = {}          # insertion-ordered
         self._absorb = absorb_profiler
+        self._const_labels = {}     # stamped on every rendered sample
+
+    # -- constant labels -----------------------------------------------------
+
+    def set_constant_labels(self, labels):
+        """Labels attached to EVERY sample this registry renders —
+        process-wide identity, e.g. {"rank": "1"} set by
+        dist.init_process_group so a multi-rank scrape distinguishes the
+        ranks' series. Replaces the previous set; {} clears."""
+        clean = {}
+        for k, v in dict(labels or {}).items():
+            v = str(v).replace("\\", "\\\\").replace('"', '\\"')
+            clean[_sanitize(str(k))] = v
+        with self._lock:
+            self._const_labels = clean
+
+    def constant_labels(self):
+        with self._lock:
+            return dict(self._const_labels)
 
     # -- creation -----------------------------------------------------------
 
@@ -316,6 +352,9 @@ class Registry:
                     lines.append(f"{name} {_fmt(val)}")
                 # strings/None/other: not a metric; JSON consumers get
                 # them via profiler.export_counters()
+        const = self.constant_labels()
+        if const:
+            lines = [_with_labels(line, const) for line in lines]
         return "\n".join(lines) + "\n"
 
     def _reset_for_tests(self):

@@ -36,7 +36,7 @@ import time
 from . import watchdog as _watchdog
 from .registry import counter, gauge, histogram
 
-__all__ = ["StepLogger", "maybe_step_logger", "enabled"]
+__all__ = ["StepLogger", "maybe_step_logger", "enabled", "log_event"]
 
 # step durations: 100us host-bound micro-steps through multi-minute
 # stalls (the watchdog owns anything beyond)
@@ -240,6 +240,27 @@ class StepLogger:
 
     def __exit__(self, *exc):
         self.close()
+        return False
+
+
+def log_event(event, **fields):
+    """Append one structured JSONL record OUTSIDE any StepLogger run —
+    rare out-of-band events (dist.py's slow-barrier warnings and
+    DistRankFailure records). Same MXNET_TELEMETRY_LOG sink as the step
+    records; open/append/close per event, so it is safe from any thread
+    at any time and costs nothing when no log is configured. Returns
+    True when a record was written."""
+    path = _log_path()
+    if not path:
+        return False
+    rec = {"event": str(event), "ts": round(time.time(), 3),
+           "pid": os.getpid()}
+    rec.update(fields)
+    try:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+        return True
+    except (OSError, ValueError, TypeError):
         return False
 
 

@@ -1,0 +1,329 @@
+"""mxnet_tpu.cluster — launcher/supervisor + fault-injection plane.
+
+Quick tier: spec parsing, injection gating, launcher supervision
+(deadline reaper, failure grace) with plain no-jax workers — seconds.
+
+Slow tier (-m slow, needs the Gloo CPU collectives backend): real
+2-process jax.distributed gangs proving the cooperative sharded commit
+hashes identically to a single-process save, ZeRO ownership-pinned
+shard placement at 2 ranks, and the `python -m mxnet_tpu.cluster
+--selftest` smoke the CI quick lane runs.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.cluster import (ClusterLauncher, cpu_collectives_available,
+                               free_port)
+from mxnet_tpu.cluster import inject
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gloo = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
+
+
+# -- inject spec parsing ------------------------------------------------------
+
+def test_parse_spec_full():
+    s = inject.parse_spec("kill@mid-cooperative-commit:1@3")
+    assert (s.action, s.point, s.rank, s.nth) == \
+        ("kill", "mid-cooperative-commit", 1, 3)
+    assert repr(s) == "kill@mid-cooperative-commit:1@3"
+
+
+def test_parse_spec_defaults():
+    s = inject.parse_spec("hang@pre-barrier")
+    assert (s.action, s.point, s.rank, s.nth) == \
+        ("hang", "pre-barrier", None, 1)
+    assert inject.parse_spec("exit@mid-step:0").rank == 0
+
+
+@pytest.mark.parametrize("bad", [
+    "kill",                      # no point
+    "explode@pre-barrier",       # unknown action
+    "kill@no-such-point",        # unknown point
+    "kill@pre-barrier:x",        # non-int rank
+    "kill@pre-barrier:1@zero",   # non-int nth
+    "kill@pre-barrier:1@0",      # nth must be >= 1
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        inject.parse_spec(bad)
+
+
+def test_points_documented():
+    # every point the runtime calls must be in the table docs render
+    for p in ("pre-barrier", "post-barrier", "mid-step", "pre-commit",
+              "mid-cooperative-commit", "pre-seal"):
+        assert p in inject.INJECTION_POINTS
+
+
+def test_maybe_inject_gating(monkeypatch):
+    inject.reset_counters()
+    # unarmed: pure no-op
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    assert inject.maybe_inject("pre-barrier") is False
+    # armed for another rank: counts nothing, fires nothing
+    monkeypatch.setenv(inject.ENV_VAR, "kill@pre-barrier:7")
+    assert inject.maybe_inject("pre-barrier") is False
+    # armed for another point
+    monkeypatch.setenv(inject.ENV_VAR, "kill@mid-step:0")
+    assert inject.maybe_inject("pre-barrier") is False
+    # malformed spec: warn-and-ignore, never raise on the hot path
+    monkeypatch.setenv(inject.ENV_VAR, "garbage")
+    assert inject.maybe_inject("pre-barrier") is False
+    inject.reset_counters()
+
+
+def test_maybe_inject_nth_hit_counting(monkeypatch):
+    inject.reset_counters()
+    fired = []
+    monkeypatch.setattr(inject, "_fire",
+                        lambda spec, point: fired.append(point) or True)
+    monkeypatch.setenv(inject.ENV_VAR, "hang@mid-step:0@3")
+    assert inject.maybe_inject("mid-step") is False      # hit 1
+    assert inject.maybe_inject("mid-step") is False      # hit 2
+    assert inject.maybe_inject("mid-step") is True       # hit 3: fires
+    assert inject.maybe_inject("mid-step") is False      # never twice
+    assert fired == ["mid-step"]
+    inject.reset_counters()
+
+
+# -- launcher supervision (no jax in the workers: pure process control) ------
+
+def _quick(nprocs=2, **kw):
+    kw.setdefault("deadline_s", 30.0)
+    kw.setdefault("stream", False)
+    return ClusterLauncher(nprocs=nprocs, **kw)
+
+
+def test_free_port_binds():
+    p = free_port()
+    assert 1024 <= p <= 65535
+
+
+def test_launch_ok_and_env_contract():
+    src = r"""
+import json, os
+print(json.dumps({"evt": "env", "rank": os.environ["DMLC_WORKER_ID"],
+                  "n": os.environ["DMLC_NUM_WORKER"],
+                  "port": os.environ["DMLC_PS_ROOT_PORT"],
+                  "inj": os.environ.get("MXNET_CLUSTER_INJECT"),
+                  "xla": os.environ["XLA_FLAGS"],
+                  "t": os.environ["MXNET_DIST_TIMEOUT_S"]}))
+"""
+    res = _quick(2, dist_timeout_s=7.5,
+                 inject="exit@mid-step:1").launch_python(src)
+    assert res.ok and res.returncodes == [0, 0]
+    evs = sorted((json.loads(line) for t in res.tails.values()
+                  for line in t.splitlines() if line.startswith("{")),
+                 key=lambda e: e["rank"])
+    assert [e["rank"] for e in evs] == ["0", "1"]
+    assert all(e["n"] == "2" for e in evs)
+    assert len({e["port"] for e in evs}) == 1   # one shared coordinator
+    assert all(e["inj"] == "exit@mid-step:1" for e in evs)
+    assert all("--xla_force_host_platform_device_count=1" in e["xla"]
+               for e in evs)
+    assert all(e["t"] == "7.5" for e in evs)
+
+
+def test_launch_captures_tails_and_failed_ranks():
+    src = r"""
+import os, sys
+rank = int(os.environ["DMLC_WORKER_ID"])
+print(f"hello from {rank}")
+sys.exit(5 if rank == 1 else 0)
+"""
+    res = _quick(2, failure_grace_s=10.0).launch_python(src)
+    assert not res.ok
+    assert res.returncodes == [0, 5]
+    assert res.failed_ranks == [1]
+    assert "hello from 0" in res.tails[0]
+
+
+def test_deadline_reaps_whole_gang():
+    src = "import time\ntime.sleep(60)\n"
+    res = _quick(2, deadline_s=1.5).launch_python(src)
+    assert res.deadline_fired
+    assert res.returncodes == [-9, -9]
+    assert sorted(res.reaped_ranks) == [0, 1]
+    assert res.elapsed_s < 20
+
+
+def test_failure_grace_reaps_survivors():
+    src = r"""
+import os, sys, time
+if os.environ["DMLC_WORKER_ID"] == "0":
+    sys.exit(3)             # dies immediately
+time.sleep(60)              # survivor never notices on its own
+"""
+    res = _quick(2, deadline_s=60.0, failure_grace_s=2.0,
+                 ).launch_python(src)
+    assert not res.deadline_fired   # grace reap, not the last resort
+    assert res.returncodes[0] == 3
+    assert res.returncodes[1] == -9
+    assert res.reaped_ranks == [1]
+    assert res.first_death_s is not None and res.first_death_s < 10
+
+
+# -- real 2-process gangs (slow tier) ----------------------------------------
+
+def _gang(nprocs, deadline_s=120.0):
+    return ClusterLauncher(
+        nprocs=nprocs, devices_per_rank=1, deadline_s=deadline_s,
+        stream=False, dist_timeout_s=30,
+        env={"PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+
+
+_COOP_WORKER = r"""
+import json, os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+
+ckdir = sys.argv[1]
+rank = int(os.environ["DMLC_WORKER_ID"])
+rng = np.random.RandomState(11)
+arrays = {f"param:p{i}": rng.normal(size=(8, 3)).astype(np.float32)
+          for i in range(5)}
+st = TrainingState(arrays=arrays, meta={"step": 3})
+mgr = CheckpointManager(ckdir, sharded=True, async_save=False,
+                        keep_last_n=0, num_shards=4)
+mgr.save(st, 3)
+if rank == 0:
+    st2 = mgr.restore()
+    print(json.dumps({"evt": "sha", "sha": state_sha256(st2)}), flush=True)
+mgr.close()
+"""
+
+
+@pytest.mark.slow
+@needs_gloo
+def test_cooperative_commit_sha_matches_single_process(tmp_path):
+    res = _gang(2).launch_python(_COOP_WORKER, (str(tmp_path / "coop"),))
+    assert res.ok, res.describe() + "\n" + "".join(res.tails.values())
+    coop_sha = next(json.loads(line)["sha"]
+                    for line in res.tails[0].splitlines()
+                    if line.startswith("{") and '"sha"' in line)
+
+    # identical snapshot saved by ONE process through the normal path
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+    rng = np.random.RandomState(11)
+    arrays = {f"param:p{i}": rng.normal(size=(8, 3)).astype(np.float32)
+              for i in range(5)}
+    st = TrainingState(arrays=arrays, meta={"step": 3})
+    single = CheckpointManager(str(tmp_path / "single"), sharded=True,
+                               async_save=False, keep_last_n=0,
+                               num_shards=4)
+    single.save(st, 3)
+    assert state_sha256(single.restore()) == coop_sha == state_sha256(st)
+    single.close()
+
+
+_ZERO_WORKER = r"""
+import json, os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+from mxnet_tpu.parallel.zero import ZeroLayout
+
+ckdir = sys.argv[1]
+rank = int(os.environ["DMLC_WORKER_ID"])
+names = ["fc1_w", "fc1_b", "fc2_w", "fc2_b"]
+shapes = [(32, 16), (32,), (4, 32), (4,)]
+layout = ZeroLayout(shapes, n_dev=2, bucket_bytes=1 << 20)
+own = layout.ownership(names, n_states=1)
+
+rng = np.random.RandomState(3)
+arrays = {}
+for n, s in zip(names, shapes):
+    arrays[f"param:{n}"] = rng.normal(size=s).astype(np.float32)
+    arrays[f"opt:{n}:0"] = np.zeros(s, np.float32)
+st = TrainingState(arrays=arrays,
+                   meta={"step": 1,
+                         "trainer": {"zero": {"ownership": own}}})
+mgr = CheckpointManager(ckdir, sharded=True, async_save=False,
+                        keep_last_n=0, num_shards=2)
+mgr.save(st, 1)
+if rank == 0:
+    st2 = mgr.restore()
+    print(json.dumps({"evt": "zero", "sha": state_sha256(st2),
+                      "own": own}), flush=True)
+mgr.close()
+"""
+
+
+@pytest.mark.slow
+@needs_gloo
+def test_zero_ownership_pinned_cooperative_commit(tmp_path):
+    """2-rank cooperative commit of a ZeRO-owned snapshot: every owned
+    array is placed WHOLE in its owner's shard (no re-gather on save),
+    and the restore hashes identically to the in-memory snapshot."""
+    ckdir = tmp_path / "zero"
+    res = _gang(2).launch_python(_ZERO_WORKER, (str(ckdir),))
+    assert res.ok, res.describe() + "\n" + "".join(res.tails.values())
+    ev = next(json.loads(line) for line in res.tails[0].splitlines()
+              if line.startswith("{") and '"zero"' in line)
+
+    from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+    from mxnet_tpu.parallel.zero import ZeroLayout
+    names = ["fc1_w", "fc1_b", "fc2_w", "fc2_b"]
+    shapes = [(32, 16), (32,), (4, 32), (4,)]
+    layout = ZeroLayout(shapes, n_dev=2, bucket_bytes=1 << 20)
+    own = layout.ownership(names, n_states=1)
+    assert ev["own"] == {k: int(v) for k, v in own.items()}
+    assert set(own.values()) == {0, 1}   # both ranks own something
+
+    rng = np.random.RandomState(3)
+    arrays = {}
+    for n, s in zip(names, shapes):
+        arrays[f"param:{n}"] = rng.normal(size=s).astype(np.float32)
+        arrays[f"opt:{n}:0"] = np.zeros(s, np.float32)
+    st = TrainingState(arrays=arrays,
+                       meta={"step": 1,
+                             "trainer": {"zero": {"ownership": own}}})
+    assert ev["sha"] == state_sha256(st)
+
+    # the sealed TOPOLOGY.json must show ownership-pinned placement:
+    # owned arrays whole in the owner's shard
+    step_dir = next(p for p in ckdir.iterdir() if p.is_dir()
+                    and not p.name.startswith("_"))
+    topo = json.loads((step_dir / "TOPOLOGY.json").read_text())
+    for name, shard in own.items():
+        ent = topo["shard_map"][name]
+        assert ent["mode"] == "whole" and ent["shard"] == shard, \
+            (name, ent)
+
+
+@pytest.mark.slow
+@needs_gloo
+def test_cluster_selftest_smoke():
+    """The exact smoke tools/ci.sh quick runs: barrier round-trip, a
+    pre-barrier SIGKILL detected within the dist timeout, and a
+    kill-mid-cooperative-commit restart that resumes from the last
+    sealed step."""
+    import subprocess
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MXNET_CLUSTER_INJECT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.cluster", "--selftest",
+         "--nprocs", "2"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    line = next(l for l in proc.stdout.splitlines()
+                if l.startswith("{") and '"cluster_selftest"' in l)
+    rep = json.loads(line)
+    assert rep["ok"] is True
+    if "detect_s" in rep:       # not present on a gloo-less skip
+        assert rep["detect_s"] < 15.0

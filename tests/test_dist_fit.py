@@ -1,13 +1,16 @@
 """Multi-process Module.fit end-to-end through the dist kvstore.
 
 Reference pattern: tests/nightly/dist_lenet.py — N real worker processes
-(tools/launch.py local launcher) train the same model via `Module.fit`
-with kv_store='dist_sync', then final parameters are checked against a
-single-process run. Parity holds exactly because dist-sync sums worker
-gradients: worker r training on data[r::N] with batch B sees, at step k,
-the index set {r + N*i : i in [kB,(k+1)B)} whose union over r is the
-contiguous block [N*kB, N*(k+1)B) — i.e. the same global batches as one
-process with batch N*B over the unsharded data.
+train the same model via `Module.fit` with kv_store='dist_sync', then
+final parameters are checked against a single-process run. Parity holds
+exactly because dist-sync sums worker gradients: worker r training on
+data[r::N] with batch B sees, at step k, the index set
+{r + N*i : i in [kB,(k+1)B)} whose union over r is the contiguous block
+[N*kB, N*(k+1)B) — i.e. the same global batches as one process with
+batch N*B over the unsharded data.
+
+The dist gang runs under mxnet_tpu.cluster's supervised launcher
+(per-rank CPU device pin + Gloo collectives + deadline/grace reaping).
 """
 import os
 import subprocess
@@ -15,8 +18,15 @@ import sys
 import tempfile
 
 import numpy as np
+import pytest
+
+from mxnet_tpu.cluster import ClusterLauncher, cpu_collectives_available
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
 
 N_WORKERS = 2
 BATCH = 8
@@ -89,39 +99,34 @@ np.savez(os.path.join(out_dir, "single_params.npz"), **params)
 """
 
 
-def _fmt(tpl):
-    return tpl
-
-
 def test_dist_module_fit_matches_single_process():
     with tempfile.TemporaryDirectory() as td:
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_NUM_CPU_DEVICES"] = "1"
-        env.update(T_BATCH=str(BATCH), T_EPOCHS=str(EPOCHS), T_LR=str(LR),
-                   T_NW=str(N_WORKERS))
+        t_env = {"T_BATCH": str(BATCH), "T_EPOCHS": str(EPOCHS),
+                 "T_LR": str(LR), "T_NW": str(N_WORKERS),
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")}
 
         single = os.path.join(td, "single.py")
         with open(single, "w") as f:
-            f.write(_fmt(SINGLE))
+            f.write(SINGLE)
+        env = dict(os.environ)
+        env.update(t_env)
+        env["JAX_NUM_CPU_DEVICES"] = "1"
         proc = subprocess.run([sys.executable, single, td], env=env,
                               capture_output=True, text=True, timeout=300)
         assert proc.returncode == 0, \
             f"single-process run failed:\n{proc.stdout}\n{proc.stderr}"
 
-        worker = os.path.join(td, "worker.py")
-        with open(worker, "w") as f:
-            f.write(_fmt(WORKER))
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", str(N_WORKERS), "--launcher", "local",
-             sys.executable, worker, td],
-            env=env, capture_output=True, text=True, timeout=420)
-        assert proc.returncode == 0, \
-            f"dist run failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        launcher = ClusterLauncher(
+            nprocs=N_WORKERS, devices_per_rank=1, deadline_s=300.0,
+            stream=False, env=t_env)
+        res = launcher.launch_python(WORKER, (td,))
+        assert res.ok, (res.describe() + "\n"
+                        + "\n".join(f"[r{r}] {t[-2000:]}"
+                                    for r, t in sorted(res.tails.items())))
         for r in range(N_WORKERS):
             assert os.path.exists(os.path.join(td, f"fit_ok_{r}")), \
-                f"worker {r} did not finish:\n{proc.stdout}\n{proc.stderr}"
+                f"worker {r} did not finish:\n{res.tails[r]}"
 
         dist = np.load(os.path.join(td, "dist_params.npz"))
         ref = np.load(os.path.join(td, "single_params.npz"))

@@ -9,23 +9,31 @@ initializes from the DMLC_* env contract, jax.process_count() sees the
 gang, host collectives (allreduce/broadcast/barrier) agree, and a
 JITTED computation over a cross-process device mesh runs a real psum
 over the DCN-analog channel.
+
+Routed through mxnet_tpu.cluster's supervised launcher: each rank is
+pinned to exactly one virtual CPU device (the raw tools/launch.py
+route inherited pytest's 8-device XLA_FLAGS and broke the 2-device
+mesh), gets the Gloo CPU-collectives backend, and a wedged rank is
+reaped instead of hanging the suite.
 """
 import os
-import subprocess
-import sys
 import tempfile
 
-import numpy as np
+import pytest
+
+from mxnet_tpu.cluster import ClusterLauncher, cpu_collectives_available
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
 
 WORKER = r"""
 import os, sys
 import jax
-jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
-sys.path.insert(0, os.environ["T_REPO"])
 from mxnet_tpu import dist
 
 rank = int(os.environ["DMLC_WORKER_ID"])
@@ -71,22 +79,14 @@ print(f"worker {rank}: PASS", flush=True)
 
 def test_two_process_jax_distributed_smoke():
     with tempfile.TemporaryDirectory() as td:
-        worker = os.path.join(td, "jd_worker.py")
-        with open(worker, "w") as f:
-            f.write(WORKER)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["T_REPO"] = REPO
-        env["JAX_NUM_CPU_DEVICES"] = "1"
-        # the launcher exports DMLC_PS_ROOT_URI/PORT + worker ids — the
-        # same env contract the reference's dmlc tracker provides
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", "2", "--launcher", "local",
-             sys.executable, worker, td],
-            env=env, capture_output=True, text=True, timeout=300)
-        assert proc.returncode == 0, \
-            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        launcher = ClusterLauncher(
+            nprocs=2, devices_per_rank=1, deadline_s=240.0, stream=False,
+            env={"PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")})
+        res = launcher.launch_python(WORKER, (td,))
+        assert res.ok, (res.describe() + "\n"
+                        + "\n".join(f"[r{r}] {t[-2000:]}"
+                                    for r, t in sorted(res.tails.items())))
         for r in range(2):
             assert os.path.exists(os.path.join(td, f"jd_ok_{r}")), \
-                f"worker {r} incomplete:\n{proc.stdout}\n{proc.stderr}"
+                f"worker {r} incomplete:\n{res.tails[r]}"

@@ -2,23 +2,26 @@
 
 Reference pattern: tests/nightly/dist_sync_kvstore.py:19-68 — N forked
 workers push known values into a dist_sync store and assert the bitwise
-expected aggregate, launched through the local tracker (tools/launch.py).
-Here the workers are real processes joined via jax.distributed over a Gloo
-CPU backend.
+expected aggregate. Here the workers are real processes joined via
+jax.distributed over a Gloo CPU backend, launched and supervised by
+mxnet_tpu.cluster (per-rank device pin, deadline, failure-grace reaping
+— a wedged worker can no longer hang the suite).
 """
 import os
-import subprocess
-import sys
 import tempfile
 
-import numpy as np
+import pytest
+
+from mxnet_tpu.cluster import ClusterLauncher, cpu_collectives_available
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
+
 WORKER = r"""
 import os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import mxnet_tpu as mx
 
@@ -72,19 +75,14 @@ print(f"worker {rank}: PASS", flush=True)
 def test_dist_sync_kvstore_three_workers():
     n = 3
     with tempfile.TemporaryDirectory() as td:
-        script = os.path.join(td, "worker.py")
-        with open(script, "w") as f:
-            f.write(WORKER)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_NUM_CPU_DEVICES"] = "1"   # conftest's 8-device mesh leaks
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", str(n), "--launcher", "local",
-             sys.executable, script, td],
-            env=env, capture_output=True, text=True, timeout=420)
-        assert proc.returncode == 0, \
-            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        launcher = ClusterLauncher(
+            nprocs=n, devices_per_rank=1, deadline_s=300.0, stream=False,
+            env={"PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")})
+        res = launcher.launch_python(WORKER, (td,))
+        assert res.ok, (res.describe() + "\n"
+                        + "\n".join(f"[r{r}] {t[-2000:]}"
+                                    for r, t in sorted(res.tails.items())))
         for r in range(n):
             assert os.path.exists(os.path.join(td, f"ok_{r}")), \
-                f"worker {r} did not finish:\n{proc.stdout}\n{proc.stderr}"
+                f"worker {r} did not finish:\n{res.tails[r]}"

@@ -46,6 +46,9 @@ case "$stage" in
     echo "== telemetry smoke (registry/scrape/JSONL/overhead/watchdog)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.telemetry --selftest
+    echo "== cluster smoke (2-proc gang: barrier, kill injection, resume)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.cluster --selftest --nprocs 2
     echo "== zero smoke (ZeRO-1 bitwise parity, fp8 convergence, HLO wire)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.parallel.zero --selftest

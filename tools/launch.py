@@ -39,6 +39,11 @@ def worker_env(rank, num_workers, uri, port):
         "DMLC_NUM_SERVER": "0",            # no server role TPU-natively
         "DMLC_WORKER_ID": str(rank),
     })
+    # CPU hosts need a cross-process collectives transport; jax's cpu
+    # client defaults to none and then refuses multi-process programs
+    # (mxnet_tpu.cluster supervises with the same env; dist.py also sets
+    # the config programmatically for processes launched another way)
+    env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
     return env
 
 
