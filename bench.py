@@ -1198,6 +1198,115 @@ def _telemetry_lane():
             "devices": n}
 
 
+def _tracing_lane():
+    """Span-tracing overhead A/B + shard-merge latency
+    (mxnet_tpu.telemetry.tracing, ISSUE 13). The same gluon fused_fit
+    run with MXNET_TRACE off vs on — steps/s each, so the tracing tax on
+    the fused hot loop is a measured number (acceptance: < 2%). The
+    traced arms also write a steplog JSONL, from which the measured
+    feed-vs-compute and comm-vs-compute overlap fractions are pulled for
+    a plain-dp arm and a ZeRO-1 arm (MXNET_ZERO_STAGE=1). Finally an
+    8-rank synthetic shard set (per-rank clock offsets/skews) is merged
+    into one timeline, timed."""
+    import tempfile
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.telemetry import tracing
+
+    batches, batch, dim, k = (8 if (QUICK or CPU_SCALE) else 16), 128, 512, 4
+    epochs = 3
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(-1, 1, (batches, batch, dim)).astype(np.float32)
+    ys = rng.randint(0, 10, (batches, batch)).astype(np.float32)
+
+    class _Data:
+        def __iter__(self):
+            return ((mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+                    for i in range(batches))
+
+    _ENV = ("MXNET_TRACE", "MXNET_TELEMETRY_LOG", "MXNET_ZERO_STAGE")
+
+    def _fit_arm(trace_on, log_path=None, zero=False, ndev=1):
+        prev = {v: os.environ.get(v) for v in _ENV}
+        os.environ["MXNET_TRACE"] = "1" if trace_on else "0"
+        if log_path:
+            os.environ["MXNET_TELEMETRY_LOG"] = log_path
+        else:
+            os.environ.pop("MXNET_TELEMETRY_LOG", None)
+        if zero:
+            os.environ["MXNET_ZERO_STAGE"] = "1"
+        else:
+            os.environ.pop("MXNET_ZERO_STAGE", None)
+        try:
+            net = nn.HybridSequential()
+            with net.name_scope():
+                net.add(nn.Dense(dim, activation="relu"))
+                net.add(nn.Dense(10))
+            net.initialize(mx.init.Xavier())
+            loss = gluon.loss.SoftmaxCrossEntropyLoss()
+            marks = []
+            gluon.trainer.fused_fit(
+                net, loss, _Data(), num_epoch=epochs,
+                optimizer="sgd", optimizer_params={"learning_rate": 0.05},
+                steps_per_dispatch=k,
+                contexts=[mx.cpu(i) for i in range(ndev)],
+                epoch_callback=lambda *a: marks.append(time.perf_counter()))
+            return (epochs - 1) * batches / (marks[-1] - marks[0])
+        finally:
+            for v, val in prev.items():
+                if val is None:
+                    os.environ.pop(v, None)
+                else:
+                    os.environ[v] = val
+
+    def _overlap_fields(log_path):
+        """Last step record's measured overlap fractions."""
+        fields = None
+        with open(log_path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "step" and \
+                        "feed_compute_overlap_frac" in rec:
+                    fields = rec
+        if fields is None:
+            raise RuntimeError(f"no traced step records in {log_path}")
+        return {"feed_compute_overlap_frac":
+                fields["feed_compute_overlap_frac"],
+                "comm_compute_overlap_frac":
+                fields["comm_compute_overlap_frac"],
+                "feed_us": fields["feed_us"],
+                "compute_us": fields["compute_us"],
+                "comm_us": fields["comm_us"]}
+
+    root = tempfile.mkdtemp(prefix="mxnet_bench_trace_")
+    ndev = min(2, len(jax.devices()))
+    base_sps = _fit_arm(False)
+    dp_log = os.path.join(root, "dp.jsonl")
+    trace_sps = _fit_arm(True, log_path=dp_log)
+    zero_log = os.path.join(root, "zero.jsonl")
+    _fit_arm(True, log_path=zero_log, zero=True, ndev=ndev)
+
+    shard_dir = os.path.join(root, "shards")
+    tracing.synth_shards(shard_dir, ranks=8, steps=5,
+                         base_wall=time.time())
+    t0 = time.perf_counter()
+    merged, summary = tracing.merge(shard_dir)
+    merge_ms = (time.perf_counter() - t0) * 1e3
+    return {"baseline_steps_per_sec": round(base_sps, 2),
+            "traced_steps_per_sec": round(trace_sps, 2),
+            "overhead_pct": round((base_sps / trace_sps - 1.0) * 100, 2),
+            "dp": _overlap_fields(dp_log),
+            "zero": _overlap_fields(zero_log),
+            "merge_ranks": 8,
+            "merge_events": summary["events"],
+            "merge_ms": round(merge_ms, 2)}
+
+
 def _analysis_lane():
     """Static-analysis gate as a measured lane (mxnet_tpu.analysis,
     ISSUE 9): one `python -m mxnet_tpu.analysis --strict --json`
@@ -1498,6 +1607,14 @@ def main(argv=None):
     except Exception as e:
         tele_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("telemetry", tele_lane)
+    # span-tracing overhead A/B + 8-rank shard-merge latency (ISSUE 13)
+    try:
+        tracing_lane = _gated("tracing", 90, _tracing_lane)
+    except _BudgetExceeded:
+        tracing_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        tracing_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("tracing", tracing_lane)
     # static-analysis strict gate, timed (ISSUE 9)
     try:
         analysis_lane = _gated("analysis", 150, _analysis_lane)

@@ -84,6 +84,7 @@ import threading
 import time
 
 from .state import TrainingState
+from ..telemetry import tracing as _tracing
 
 # analysis/locklint: _prev_sigterm is only touched from the main thread
 # (install/remove_sigterm_hook are main-thread-only by the signal-module
@@ -589,17 +590,25 @@ class CheckpointManager:
             self.num_shards, ownership=self._zero_ownership(state))
         shards = {}
         nbytes = 0
+        # "ckpt" phase spans cover the leaf work (stage/seal) only — the
+        # enclosing commit event records without a phase so StepLogger's
+        # ckpt_us delta counts each committed microsecond once
+        t_stage = time.perf_counter()
         for k, files in enumerate(shard_files):
             sname, msha, n = self._write_shard(staging, k, files, step)
             shards[sname] = {"manifest_sha256": msha}
             nbytes += n
+        _tracing.event("ckpt.stage", t_stage, phase="ckpt", step=int(step))
+        t_seal = time.perf_counter()
         self._seal_step(staging, state, step, metric, shards, shard_map)
         _maybe_crash("pre-rename", step)
         if os.path.isdir(final):               # re-save of the same step
             shutil.rmtree(final)
         os.replace(staging, final)
         _fsync_dir(self.directory)
+        _tracing.event("ckpt.seal", t_seal, phase="ckpt", step=int(step))
         _maybe_crash("post-rename", step)
+        _tracing.event("ckpt.commit", t0, step=int(step))
         self._finish_commit(step, nbytes, time.perf_counter() - t0)
 
     def _commit_cooperative(self, state, step, metric):
@@ -633,12 +642,14 @@ class CheckpointManager:
             self.num_shards, ownership=self._zero_ownership(state))
         shards = {}
         nbytes = 0
+        t_stage = time.perf_counter()
         for k, files in enumerate(shard_files):
             if k % self._nranks != self._rank:
                 continue
             sname, msha, n = self._write_shard(staging, k, files, step)
             shards[sname] = {"manifest_sha256": msha}
             nbytes += n
+        _tracing.event("ckpt.stage", t_stage, phase="ckpt", step=int(step))
         maybe_inject("mid-cooperative-commit")
         dist.barrier(f"ckpt_shards_{step}")
         if self._rank == 0:
@@ -654,6 +665,7 @@ class CheckpointManager:
                     "manifest_sha256":
                         hashlib.sha256(mpayload).hexdigest()}
             maybe_inject("pre-seal")
+            t_seal = time.perf_counter()
             self._seal_step(staging, state, step, metric, shards,
                             shard_map)
             _maybe_crash("pre-rename", step)
@@ -661,8 +673,11 @@ class CheckpointManager:
                 shutil.rmtree(final)
             os.replace(staging, final)
             _fsync_dir(self.directory)
+            _tracing.event("ckpt.seal", t_seal, phase="ckpt",
+                           step=int(step))
             _maybe_crash("post-rename", step)
         dist.barrier(f"ckpt_seal_{step}")
+        _tracing.event("ckpt.commit", t0, step=int(step))
         self._finish_commit(step, nbytes, time.perf_counter() - t0)
 
     def _finish_commit(self, step, nbytes, save_s):

@@ -8,7 +8,9 @@ multi-process runtime.
 
 Smoke phases (ci.sh quick): a 2-process barrier/collective round-trip;
 an injected SIGKILL pre-barrier whose survivor raises `DistRankFailure`
-naming the dead rank within MXNET_DIST_TIMEOUT_S; a kill mid-cooperative
+naming the dead rank within MXNET_DIST_TIMEOUT_S — and whose postmortem
+(every rank's flight-recorder black box, plus the merged span-trace
+timeline) names the same victim rank; a kill mid-cooperative
 checkpoint commit (torn step never sealed) followed by a
 supervisor-driven restart that resumes from the last sealed commit and
 finishes the run.
@@ -171,11 +173,65 @@ print(json.dumps({"evt": "final", "rank": rank, "step": steps,
 """
 
 
-def _launcher(nprocs, deadline_s, inject=None, retries=0, stream=True):
+def _launcher(nprocs, deadline_s, inject=None, retries=0, stream=True,
+              extra_env=None):
+    env = _base_env()
+    if extra_env:
+        env.update(extra_env)
     return ClusterLauncher(nprocs=nprocs, deadline_s=deadline_s,
                            dist_timeout_s=_TIMEOUT_S,
                            dist_retries=retries, inject=inject,
-                           env=_base_env(), stream=stream)
+                           env=env, stream=stream)
+
+
+def _trace_env(trace_dir):
+    """Arm span tracing in every rank (the launcher arms the flight
+    recorder on its own): fast periodic shard/box flushes so a rank
+    killed within its first half second of useful work — the barrier
+    worker's whole post-import life — still leaves a recent
+    trace-rank-K.json and flight-recorder box on disk."""
+    return {"MXNET_TRACE": "1", "MXNET_TRACE_DIR": trace_dir,
+            "MXNET_TRACE_FLUSH_S": "0.05",
+            "MXNET_FLIGHTREC_FLUSH_S": "0.05"}
+
+
+def _check_postmortem(res, victim, trace_dir, phase, report):
+    """Observability acceptance gate for an injected kill/hang: every
+    rank left a flight-recorder black box, the launcher's quiet-rank
+    triage names the victim, and the per-rank trace shards merge into
+    valid chrome-trace JSON whose summary names the victim too."""
+    from ..telemetry import tracing
+    _check(len(res.blackboxes) >= 2,
+           f"{phase}: expected a black box from every rank, got "
+           f"{sorted(res.blackboxes)} in {res.blackbox_dir}")
+    _check(victim in res.blackboxes,
+           f"{phase}: the victim rank {victim} left no black box "
+           "(flusher never wrote before the fault)")
+    _check(res.quiet_rank == victim,
+           f"{phase}: triage named rank {res.quiet_rank} quiet-first, "
+           f"expected the injected victim {victim}")
+    out, summary = tracing.merge(trace_dir)
+    with open(out, encoding="utf-8") as f:
+        trace = json.load(f)
+    evs = trace.get("traceEvents")
+    _check(isinstance(evs, list) and len(evs) > 0,
+           f"{phase}: merged trace has no traceEvents list")
+    _check(all(isinstance(e, dict) and "ph" in e and "pid" in e
+               for e in evs),
+           f"{phase}: merged trace carries malformed events")
+    _check(all("ts" in e and "dur" in e and "tid" in e
+               for e in evs if e.get("ph") == "X"),
+           f"{phase}: merged complete-events are missing ts/dur/tid")
+    q = summary.get("quiet_first") or {}
+    _check(q.get("rank") == victim,
+           f"{phase}: merged-timeline summary named rank "
+           f"{q.get('rank')} quiet-first, expected {victim}")
+    report[f"{phase}_blackboxes"] = len(res.blackboxes)
+    report[f"{phase}_merged_events"] = summary["events"]
+    report["quiet_rank"] = res.quiet_rank
+    print(f"cluster-selftest: {phase} postmortem OK "
+          f"({len(res.blackboxes)} black boxes, {summary['events']} "
+          f"merged trace events, quiet-first = rank {victim})")
 
 
 def _no_reap(result, phase):
@@ -228,8 +284,10 @@ def phase_barrier_roundtrip(nprocs, report):
 
 def phase_kill_pre_barrier(nprocs, report):
     victim = nprocs - 1
+    trace_dir = tempfile.mkdtemp(prefix="mxnet_cluster_trace_")
     res = _launcher(nprocs, deadline_s=90.0,
-                    inject=f"kill@pre-barrier:{victim}@2").launch_python(
+                    inject=f"kill@pre-barrier:{victim}@2",
+                    extra_env=_trace_env(trace_dir)).launch_python(
         _BARRIER_WORKER)
     _check(res.returncodes[victim] == -9,
            f"kill_pre_barrier: victim rc={res.returncodes[victim]}, "
@@ -245,6 +303,7 @@ def phase_kill_pre_barrier(nprocs, report):
     report["detect_s"] = round(detect, 2)
     print(f"cluster-selftest: kill_pre_barrier OK "
           f"(DistRankFailure named rank {victim} in {detect:.1f}s)")
+    _check_postmortem(res, victim, trace_dir, "kill_pre_barrier", report)
 
 
 def phase_restart_resume(nprocs, report, check_shas=None):
@@ -338,8 +397,10 @@ def phase_hang_pre_barrier(nprocs, report):
     """SIGSTOP (not death — a wedged rank): the survivor's barrier
     timeout must fire and the supervisor must reap the frozen rank."""
     victim = nprocs - 1
+    trace_dir = tempfile.mkdtemp(prefix="mxnet_cluster_trace_")
     res = _launcher(nprocs, deadline_s=90.0,
-                    inject=f"hang@pre-barrier:{victim}@2").launch_python(
+                    inject=f"hang@pre-barrier:{victim}@2",
+                    extra_env=_trace_env(trace_dir)).launch_python(
         _BARRIER_WORKER)
     _survivor_failed(res, victim, "hang_pre_barrier")
     _check(victim in res.reaped_ranks,
@@ -347,6 +408,7 @@ def phase_hang_pre_barrier(nprocs, report):
            f"({res.describe()})")
     print("cluster-selftest: hang_pre_barrier OK (survivor aborted, "
           "frozen rank reaped)")
+    _check_postmortem(res, victim, trace_dir, "hang_pre_barrier", report)
 
 
 def phase_exit_mid_step(nprocs, report):

@@ -17,6 +17,13 @@ without it the CPU backend refuses multi-process computations, which is
 why the three seed-era `tests/test_dist_*` suites never ran their
 multi-rank path.
 
+Every rank also keeps a flight-recorder black box (telemetry.flightrec)
+flushed to `blackbox_dir`; after a failed run the launcher collects the
+per-rank `flightrec-rank-K.json` files and prints the interleaved
+last-N-seconds timeline, naming which rank went quiet first (a SIGKILLed
+or hung rank's box stops updating while the survivors keep recording
+their barrier waits — earliest last-event timestamp fingers the victim).
+
 Concurrency surfaces (analysis/locklint contract): each rank's log pump
 is one daemon thread appending to that rank's own deque (GIL-atomic
 appends, single writer) and to the shared stream under `_stream_lock`;
@@ -25,6 +32,8 @@ the supervisor loop only ever reads. No other cross-thread state.
 from __future__ import annotations
 
 import collections
+import glob
+import json
 import os
 import signal
 import socket
@@ -82,7 +91,7 @@ class ClusterResult:
     reaping and no deadline; timing fields feed the bench lane."""
 
     def __init__(self, ranks, elapsed_s, deadline_fired, first_death_t,
-                 t0):
+                 t0, blackboxes=None, blackbox_dir=None):
         self.returncodes = [rp.exit_rc for rp in ranks]
         self.elapsed_s = elapsed_s
         self.deadline_fired = deadline_fired
@@ -95,6 +104,22 @@ class ClusterResult:
         self.exit_s = [None if rp.exit_t is None else rp.exit_t - t0
                        for rp in ranks]
         self.tails = {rp.rank: rp.log_text() for rp in ranks}
+        # per-rank flight-recorder black boxes (rank -> parsed dump)
+        self.blackbox_dir = blackbox_dir
+        self.blackboxes = dict(blackboxes or {})
+        self.quiet_rank = self._quiet_rank()
+
+    def _quiet_rank(self):
+        """The rank whose black box stopped updating first — on a
+        kill/hang injection that is the victim (survivors keep flushing
+        while they wait out the dist timeout). Needs >= 2 boxes with
+        events to be meaningful."""
+        last = {r: b.get("last_event_t")
+                for r, b in self.blackboxes.items()
+                if b.get("last_event_t")}
+        if len(last) < 2:
+            return None
+        return min(last, key=last.get)
 
     @property
     def ok(self):
@@ -102,9 +127,57 @@ class ClusterResult:
                 and all(rc == 0 for rc in self.returncodes))
 
     def describe(self):
+        quiet = "" if self.quiet_rank is None \
+            else f" quiet_rank={self.quiet_rank}"
         return (f"rcs={self.returncodes} reaped={self.reaped_ranks} "
                 f"deadline_fired={self.deadline_fired} "
-                f"elapsed={self.elapsed_s:.1f}s")
+                f"elapsed={self.elapsed_s:.1f}s{quiet}")
+
+    def triage(self, last_s=20.0, max_events=120):
+        """The postmortem: every rank's flight-recorder events from the
+        last `last_s` seconds, interleaved on the shared wall clock,
+        headed by which rank went quiet first. Timestamps are printed
+        as seconds-before-the-end (-0.00s is the newest event in the
+        pod) so the silence gap is visible at a glance."""
+        if not self.blackboxes:
+            return "cluster triage: no flight-recorder black boxes " \
+                   "were collected\n"
+        last = {r: b.get("last_event_t") or 0.0
+                for r, b in self.blackboxes.items()}
+        t_end = max(last.values())
+        lines = ["cluster triage: flight-recorder timeline "
+                 f"(last {last_s:.0f}s, {len(self.blackboxes)} black "
+                 "box(es))"]
+        if self.quiet_rank is not None:
+            q = self.quiet_rank
+            lines.append(
+                f"cluster triage: rank {q} went quiet FIRST — its last "
+                f"event is {t_end - last[q]:.2f}s older than the pod's "
+                "newest")
+        for r in sorted(self.blackboxes):
+            box = self.blackboxes[r]
+            lines.append(
+                f"  r{r}: {len(box.get('events', []))} event(s) "
+                f"buffered, {box.get('dropped', 0)} dropped, reason="
+                f"{box.get('reason', '?')!r}, last event "
+                f"{t_end - last[r]:.2f}s before end")
+        merged = []
+        for r, box in self.blackboxes.items():
+            for e in box.get("events", []):
+                t = e.get("t", 0.0)
+                if t >= t_end - float(last_s):
+                    merged.append((t, r, e))
+        merged.sort(key=lambda x: (x[0], x[1]))
+        for t, r, e in merged[-int(max_events):]:
+            dur = f" {e['dur_us'] / 1000.0:.3f}ms" if "dur_us" in e \
+                else ""
+            extra = {k: v for k, v in e.items()
+                     if k not in ("t", "thr", "kind", "name", "dur_us")}
+            lines.append(f"  [{t - t_end:+8.3f}s r{r} "
+                         f"{e.get('thr', '?')}] {e.get('kind', 'ev')} "
+                         f"{e.get('name', '?')}{dur}"
+                         f"{' ' + json.dumps(extra) if extra else ''}")
+        return "\n".join(lines) + "\n"
 
 
 class ClusterLauncher:
@@ -127,12 +200,15 @@ class ClusterLauncher:
     env : extra env vars for every rank
     stream : echo per-rank output with `[rN] ` prefixes (always captured
         in the per-rank tail either way)
+    blackbox_dir : where each rank's flight recorder flushes its black
+        box (default: a fresh temp dir per launcher); collected into
+        `ClusterResult.blackboxes` after every launch
     """
 
     def __init__(self, nprocs=None, devices_per_rank=1, deadline_s=120.0,
                  failure_grace_s=None, dist_timeout_s=None,
                  dist_retries=None, inject=None, env=None, stream=True,
-                 tail_lines=500, python=None):
+                 tail_lines=500, python=None, blackbox_dir=None):
         if nprocs is None:
             try:
                 nprocs = int(os.environ.get("MXNET_CLUSTER_NPROCS", "2"))
@@ -155,6 +231,8 @@ class ClusterLauncher:
         self.stream = stream
         self.tail_lines = int(tail_lines)
         self.python = python or sys.executable
+        self.blackbox_dir = blackbox_dir or tempfile.mkdtemp(
+            prefix="mxnet_blackbox_")
         self._stream_lock = threading.Lock()
 
     # -- environment ---------------------------------------------------------
@@ -181,6 +259,11 @@ class ClusterLauncher:
         env["XLA_FLAGS"] = " ".join(flags)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+        # black-box contract: every rank flushes its flight recorder
+        # under the launcher's dir so a SIGKILLed rank still leaves a
+        # postmortem (setdefault — an explicit caller env wins)
+        env.setdefault("MXNET_FLIGHTREC_DIR", self.blackbox_dir)
+        env.setdefault("MXNET_FLIGHTREC_FLUSH_S", "0.5")
         if self.dist_timeout_s is not None:
             env["MXNET_DIST_TIMEOUT_S"] = str(self.dist_timeout_s)
         if self.dist_retries is not None:
@@ -267,8 +350,28 @@ class ClusterLauncher:
                     first_death_t = now
         for p in pumps:
             p.join(timeout=5)
-        return ClusterResult(ranks, time.monotonic() - t0,
-                             deadline_fired, first_death_t, t0)
+        result = ClusterResult(ranks, time.monotonic() - t0,
+                               deadline_fired, first_death_t, t0,
+                               blackboxes=self.collect_blackboxes(),
+                               blackbox_dir=self.blackbox_dir)
+        if not result.ok and result.blackboxes:
+            self._emit(result.triage())
+        return result
+
+    def collect_blackboxes(self):
+        """Parse every rank's flight-recorder dump from blackbox_dir
+        (rank -> box dict). Tolerant of missing/torn files — a rank that
+        died before its first flush simply has no box."""
+        boxes = {}
+        pat = os.path.join(self.blackbox_dir, "flightrec-rank-*.json")
+        for path in sorted(glob.glob(pat)):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    box = json.load(f)
+                boxes[int(box.get("rank", -1))] = box
+            except (OSError, ValueError):   # pragma: no cover - torn file
+                continue
+        return boxes
 
     def launch_python(self, source, args=(), workdir=None):
         """Write `source` to a worker script and launch it on every rank

@@ -132,6 +132,28 @@ _DOCUMENTED = {
     "MXNET_DIST_RETRIES": 1,
     "MXNET_CLUSTER_NPROCS": 2,
     "MXNET_CLUSTER_INJECT": None,
+    # distributed span tracing (telemetry/tracing.py, docs/TELEMETRY.md):
+    # MXNET_TRACE=1 records host-side phase spans (feed/compute/comm/
+    # ckpt/serve) into the shared profiler event ring and writes this
+    # rank's trace-rank-K.json shard at exit; MXNET_TRACE_DIR places the
+    # shards; MXNET_TRACE_FLUSH_S (float-string seconds, 0 = exit-only)
+    # additionally snapshots the shard periodically so SIGKILL'd ranks
+    # leave a recent one; MXNET_TRACE_MAX_EVENTS bounds the shared
+    # chrome-event ring (profiler ops + spans; evictions are counted)
+    "MXNET_TRACE": 0,
+    "MXNET_TRACE_DIR": None,
+    "MXNET_TRACE_FLUSH_S": "0",
+    "MXNET_TRACE_MAX_EVENTS": 200000,
+    # crash flight recorder (telemetry/flightrec.py): MXNET_FLIGHTREC=0
+    # disables the always-on in-memory ring of recent spans/events;
+    # MXNET_FLIGHTREC_EVENTS sizes it; MXNET_FLIGHTREC_DIR makes crash
+    # triggers (DistRankFailure, uncaught exception, SIGTERM) and the
+    # periodic flusher write flightrec-rank-K.json black boxes there;
+    # MXNET_FLIGHTREC_FLUSH_S is the flusher interval
+    "MXNET_FLIGHTREC": 1,
+    "MXNET_FLIGHTREC_EVENTS": 4096,
+    "MXNET_FLIGHTREC_DIR": None,
+    "MXNET_FLIGHTREC_FLUSH_S": "0.5",
     # static analysis (mxnet_tpu.analysis, docs/ANALYSIS.md):
     # MXNET_ANALYSIS_BASELINE=<path> points the finding-suppression
     # baseline somewhere other than tools/analysis_baseline.json;
@@ -249,6 +271,20 @@ def _apply_startup():
     if get("MXNET_TELEMETRY_STALL_S") not in (None, ""):
         from .telemetry import watchdog
         watchdog.install()
+    if get("MXNET_TRACE"):
+        from .telemetry import tracing
+        tracing.arm_autodump()
+        from . import profiler as _prof
+        _prof.set_max_events(get("MXNET_TRACE_MAX_EVENTS"))
+    # flight-recorder crash triggers: armed whenever a dump dir is
+    # configured or this process is a gang member (the launcher sets
+    # MXNET_FLIGHTREC_DIR for every rank; the in-memory ring itself
+    # records regardless)
+    if get("MXNET_FLIGHTREC") and (
+            get("MXNET_FLIGHTREC_DIR")
+            or int(os.environ.get("DMLC_NUM_WORKER", "1")) > 1):
+        from .telemetry import flightrec
+        flightrec.install()
     # Join the distributed job NOW if launched by tools/launch.py:
     # jax.distributed.initialize must run before any XLA backend use, and
     # user scripts create arrays long before they reach

@@ -276,11 +276,21 @@ def _log_event(event, **fields):
 
 def _fail(what, missing, reason, elapsed_s):
     """The one exit ramp for a dead rendezvous: coordinated abort key,
-    all-thread stack dump, failure counter, JSONL record, raise."""
+    all-thread stack dump, flight-recorder + trace-shard black boxes,
+    failure counter, JSONL record, raise."""
     _post_abort(f"{what}: {reason}")
     try:
         from .telemetry import watchdog
         watchdog.dump_now(reason=f"dist {what} failed: {reason}")
+    except Exception:                       # pragma: no cover
+        pass
+    try:
+        from .telemetry import flightrec, tracing
+        flightrec.record("error", f"dist_failure:{what}",
+                         reason=str(reason)[:200],
+                         missing=list(missing))
+        flightrec.dump(reason=f"DistRankFailure: {what}: {reason}")
+        tracing.dump()      # the shard too: dist failfast skips atexit
     except Exception:                       # pragma: no cover
         pass
     _, c_fail = _metrics()
@@ -319,7 +329,15 @@ def _run_guarded(fn, what, timeout_s):
     thread beats the stall watchdog (waiting is liveness, not a hang),
     polls for peer abort keys, logs slow (>5s) waits, and converts a
     blown deadline or a transport error into DistRankFailure instead of
-    a forever-block. Returns fn()'s value."""
+    a forever-block. Returns fn()'s value. The whole wait — including a
+    failed one — is a "comm" trace span, so per-rank timelines show who
+    sat in which rendezvous for how long."""
+    from .telemetry import tracing
+    with tracing.span(f"dist.{what}", phase="comm"):
+        return _wait_guarded(fn, what, timeout_s)
+
+
+def _wait_guarded(fn, what, timeout_s):
     from .telemetry import watchdog
     box = {}
     done = threading.Event()
@@ -468,4 +486,12 @@ def barrier(name="kvstore", timeout_s=None, retries=None):
                 "retrying in %.2fs", name, attempt + 1, tries + 1,
                 elapsed, backoff)
             time.sleep(backoff)
+    # one-shot cross-rank clock exchange right after the first barrier
+    # all ranks cleared together: the per-shard wall-clock skew the
+    # trace merge uses (tracing.exchange_clock is idempotent)
+    try:
+        from .telemetry import tracing
+        tracing.exchange_clock(client)
+    except Exception:                       # pragma: no cover
+        pass
     inject.maybe_inject("post-barrier")

@@ -196,6 +196,7 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
     k = int(steps_per_dispatch)
     epoch_losses = []
     from ..telemetry import maybe_step_logger
+    from ..telemetry import tracing as _tracing
     slog = maybe_step_logger("gluon_fused_fit", meta={
         "optimizer": optimizer, "steps_per_dispatch": k,
         "batch_size": batch, "num_epoch": num_epoch,
@@ -215,9 +216,12 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
                                   name="gluon_fused_fit")
             try:
                 for inputs, n_blk in feed:
-                    params, states, aux, losses, _ = trainer.step_k(
-                        params, states, aux, inputs)
-                    blk_loss = float(np.sum(np.asarray(losses)))
+                    # "compute" span: fused dispatch + the loss sync
+                    with _tracing.span("step.fused_dispatch",
+                                       phase="compute", k=n_blk):
+                        params, states, aux, losses, _ = trainer.step_k(
+                            params, states, aux, inputs)
+                        blk_loss = float(np.sum(np.asarray(losses)))
                     total += blk_loss
                     count += n_blk * batch
                     # the np.asarray above already synced on the block's

@@ -302,6 +302,7 @@ class BaseModule:
         # liveness beat for the stall watchdog. MXNET_TELEMETRY=0 swaps
         # in the null recorder (watchdog beats only).
         from ..telemetry import maybe_step_logger
+        from ..telemetry import tracing as _tracing
         slog = maybe_step_logger("module_fit", meta={
             "optimizer": optimizer if isinstance(optimizer, str)
             else type(optimizer).__name__,
@@ -346,6 +347,7 @@ class BaseModule:
                     while data_batch is not None:
                         if monitor is not None:
                             monitor.tic()
+                        _t0 = time.perf_counter()
                         self.forward_backward(data_batch)
                         self.update()
                         upcoming = next(data_iter, None)
@@ -356,6 +358,9 @@ class BaseModule:
                             self.prepare(upcoming,
                                          sparse_row_id_fn=sparse_row_id_fn)
                         self.update_metric(eval_metric, data_batch.label)
+                        # "compute" span over dispatch + the metric sync
+                        _tracing.event("step.dispatch", _t0,
+                                       phase="compute")
                         if monitor is not None:
                             monitor.toc_print()
                         # contract: callbacks fire AFTER the metric update

@@ -500,6 +500,7 @@ class Module(BaseModule):
         from ..base import to_numpy as _np_of
         from ..pipeline import feed_or_inline, close_feed
         from ..telemetry import maybe_step_logger
+        from ..telemetry import tracing as _tracing
         slog = maybe_step_logger("module_fit_fused", meta={
             "optimizer": optimizer, "steps_per_dispatch": int(k),
             "batch_size": int(batch_size), "begin_epoch": begin_epoch,
@@ -569,20 +570,26 @@ class Module(BaseModule):
                                       name="module_fit_fused")
                 try:
                     for inputs, label_np, n_blk in feed:
-                        params, states, aux, losses, outputs = \
-                            trainer.step_k(params, states, aux, inputs,
-                                           outputs_mode="all")
-                        # metric over ALL K batches at once: flatten the
-                        # scan axis into the batch axis (same samples K=1
-                        # would feed one by one, one update call instead
-                        # of K)
-                        pred_dict = {
-                            name: NDArray(o.reshape((-1,) + o.shape[2:]))
-                            for name, o in zip(self._output_names,
-                                               outputs)}
-                        label_dict = {name: NDArray(v)
-                                      for name, v in label_np.items()}
-                        eval_metric.update_dict(label_dict, pred_dict)
+                        # "compute" span: the fused dispatch plus the
+                        # metric update that syncs on its outputs — i.e.
+                        # the device-bound slice of the loop body
+                        with _tracing.span("step.fused_dispatch",
+                                           phase="compute", k=n_blk):
+                            params, states, aux, losses, outputs = \
+                                trainer.step_k(params, states, aux,
+                                               inputs, outputs_mode="all")
+                            # metric over ALL K batches at once: flatten
+                            # the scan axis into the batch axis (same
+                            # samples K=1 would feed one by one, one
+                            # update call instead of K)
+                            pred_dict = {
+                                name: NDArray(
+                                    o.reshape((-1,) + o.shape[2:]))
+                                for name, o in zip(self._output_names,
+                                                   outputs)}
+                            label_dict = {name: NDArray(v)
+                                          for name, v in label_np.items()}
+                            eval_metric.update_dict(label_dict, pred_dict)
                         # one record per fused dispatch (K steps); the
                         # metric update above already synced on outputs,
                         # so the wall time covers real device work

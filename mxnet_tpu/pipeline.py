@@ -40,6 +40,8 @@ import time
 
 import numpy as np
 
+from .telemetry import tracing as _tracing
+
 __all__ = ["DeviceFeed", "module_stage", "enabled", "default_depth",
            "stats", "reset_stats"]
 
@@ -160,11 +162,16 @@ class DeviceFeed:
         try:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
-                try:
-                    item = next(self._source)
-                except StopIteration:
-                    break
-                staged = self._stage(item)
+                # feeder-side work records under "feed_stage", NOT
+                # "feed": StepLogger's feed_us/overlap fraction counts
+                # only consumer-blocked time (the "feed" phase below)
+                with _tracing.span("feed.stage", phase="feed_stage",
+                                   feed=self.name):
+                    try:
+                        item = next(self._source)
+                    except StopIteration:
+                        break
+                    staged = self._stage(item)
                 dt_us = int((time.perf_counter() - t0) * 1e6)
                 self.stage_us += dt_us
                 _bump("feed_stage_us", dt_us)
@@ -182,7 +189,8 @@ class DeviceFeed:
         if self._done:
             raise StopIteration
         t0 = time.perf_counter()
-        kind, val = self._q.get()
+        with _tracing.span("feed.wait", phase="feed", feed=self.name):
+            kind, val = self._q.get()
         dt_us = int((time.perf_counter() - t0) * 1e6)
         self.wait_us += dt_us
         _bump("feed_wait_us", dt_us)

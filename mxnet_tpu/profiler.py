@@ -26,7 +26,8 @@ __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state", "Domain", "Task",
            "Counter", "Marker", "Frame", "register_counter_export",
            "unregister_counter_export", "export_counters",
-           "export_counter"]
+           "export_counter", "EventRing", "events_snapshot", "clear_events",
+           "dropped_events", "set_max_events"]
 
 _lock = threading.Lock()
 _state = "stop"
@@ -41,7 +42,61 @@ _paused = False
 #    APIs); readers tolerate a stale boolean for one event
 __analysis_shared__ = {"Counter"}
 __analysis_thread_safe__ = {"_state", "_paused", "_xplane_active"}
-_events = []            # chrome trace events
+
+
+class EventRing:
+    """Bounded chrome-event buffer with drop accounting.
+
+    Shared by the profiler op lane and telemetry.tracing's span stream: a
+    long-lived server or multi-day fit must not grow an unbounded _events
+    list (the pre-ring behavior), so the ring keeps the most recent
+    `capacity` events and counts what it evicted. All mutation happens
+    under the module _lock (callers hold it), so the ring itself carries
+    no lock.
+    """
+
+    def __init__(self, capacity):
+        self._cap = max(1, int(capacity))
+        from collections import deque
+        self._dq = deque(maxlen=self._cap)
+        self.dropped = 0          # evicted since last clear()
+        self.total = 0            # appended since last clear()
+
+    @property
+    def capacity(self):
+        return self._cap
+
+    def append(self, ev):
+        if len(self._dq) >= self._cap:
+            self.dropped += 1
+        self.total += 1
+        self._dq.append(ev)
+
+    def __len__(self):
+        return len(self._dq)
+
+    def snapshot(self):
+        return list(self._dq)
+
+    def clear(self):
+        self._dq.clear()
+        self.dropped = 0
+        self.total = 0
+
+    def set_capacity(self, capacity):
+        from collections import deque
+        self._cap = max(1, int(capacity))
+        self._dq = deque(self._dq, maxlen=self._cap)
+
+
+def _ring_capacity():
+    try:
+        return int(os.environ.get("MXNET_TRACE_MAX_EVENTS", "200000"))
+    except ValueError:
+        return 200000
+
+
+_events = EventRing(_ring_capacity())   # chrome trace events (bounded ring)
 _agg = {}               # name -> [count, total_us, min_us, max_us]
 _config = {
     "filename": "profile.json",
@@ -234,10 +289,36 @@ def export_counters(format="dict"):
     return out
 
 
+def events_snapshot():
+    """Thread-safe snapshot of the buffered chrome events (tracing.dump
+    builds per-rank trace shards from this without draining the ring)."""
+    with _lock:
+        return _events.snapshot()
+
+
+def clear_events():
+    with _lock:
+        _events.clear()
+
+
+def dropped_events():
+    """Events evicted from the bounded ring since the last clear."""
+    with _lock:
+        return _events.dropped
+
+
+def set_max_events(capacity):
+    """Resize the shared event ring (MXNET_TRACE_MAX_EVENTS at import)."""
+    with _lock:
+        _events.set_capacity(capacity)
+
+
 def dump(finished=True, profile_process="worker"):
     """Write the chrome-trace JSON (chrome://tracing / perfetto loadable)."""
     with _lock:
-        trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        trace = {"traceEvents": _events.snapshot(), "displayTimeUnit": "ms",
+                 "metadata": {"dropped_events": _events.dropped,
+                              "total_events": _events.total}}
     counters = export_counters()
     if counters:
         trace["counters"] = counters

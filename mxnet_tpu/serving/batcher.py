@@ -35,6 +35,7 @@ from collections import deque
 import numpy as np
 
 from .metrics import ServingMetrics
+from ..telemetry import tracing as _tracing
 
 
 class ServingQueueFull(RuntimeError):
@@ -79,12 +80,14 @@ class Future:
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "t_submit", "deadline", "future")
+    __slots__ = ("arrays", "rows", "t_submit", "t_perf", "deadline",
+                 "future")
 
     def __init__(self, arrays, rows, deadline):
         self.arrays = arrays
         self.rows = rows
         self.t_submit = time.monotonic()
+        self.t_perf = time.perf_counter()   # tracing's clock (spans)
         self.deadline = deadline
         self.future = Future(deadline)
 
@@ -244,6 +247,12 @@ class DynamicBatcher:
                       for i in range(len(batch[0].arrays))] \
                 if len(batch) > 1 else list(batch[0].arrays)
             rows = sum(r.rows for r in batch)
+            # queue->batch handoff: each request's time-in-queue becomes
+            # a retrospective "serve" span; the engine's serve.compute
+            # span follows inside infer()
+            for r in batch:
+                _tracing.event("serve.queue", r.t_perf, phase="serve",
+                               rows=r.rows)
             try:
                 outs = self.engine.infer(*arrays)
             except Exception as e:

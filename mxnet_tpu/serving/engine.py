@@ -32,6 +32,7 @@ import threading
 import numpy as np
 
 from ..predictor import Predictor
+from ..telemetry import tracing as _tracing
 
 
 def _pow2_buckets(max_batch):
@@ -190,13 +191,18 @@ class ServingEngine:
             arrays = [np.concatenate(
                 [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)],
                 axis=0) for a in arrays]
-        with self._lock:
-            # padding accounting under the lock: infer() runs concurrently
-            # on batcher-worker and direct-caller threads, and += on a
-            # bare attribute loses updates under that interleaving
-            if bucket != n:
-                self.padded_rows += bucket - n
-            outs = self._run(bucket, arrays)
+        # "serve" span covers lock wait + plan execution — the
+        # request-visible compute latency
+        with _tracing.span("serve.compute", phase="serve",
+                           bucket=bucket, rows=n):
+            with self._lock:
+                # padding accounting under the lock: infer() runs
+                # concurrently on batcher-worker and direct-caller
+                # threads, and += on a bare attribute loses updates
+                # under that interleaving
+                if bucket != n:
+                    self.padded_rows += bucket - n
+                outs = self._run(bucket, arrays)
         return [np.asarray(o)[:n]
                 if getattr(o, "ndim", 0) and np.asarray(o).shape[0] == bucket
                 else np.asarray(o) for o in outs]

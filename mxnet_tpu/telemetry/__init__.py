@@ -19,7 +19,15 @@ Beyond-reference subsystem (docs/TELEMETRY.md). Four pieces:
   - **hang diagnostics** (watchdog.py): stall watchdog
     (`MXNET_TELEMETRY_STALL_S`) dumping all-thread stacks when a step
     stalls, SIGUSR1 on-demand dumps, and deadline dumps for budgeted
-    harnesses (bench.py).
+    harnesses (bench.py). Stall dumps append the flight-recorder tail.
+  - **span tracing** (tracing.py): `MXNET_TRACE=1` host-side spans over
+    feed/compute/comm/ckpt/serve phases, per-rank `trace-rank-K.json`
+    chrome-trace shards with clock metadata, and `--merge` fusing a
+    gang's shards into one pod timeline with a critical-path summary.
+  - **flight recorder** (flightrec.py): always-on bounded ring of recent
+    spans/events dumped as a per-rank black box on DistRankFailure,
+    watchdog stall, uncaught exception, or SIGTERM; the cluster launcher
+    collects the boxes and names the rank that went quiet first.
 
 Selftest: `python -m mxnet_tpu.telemetry --selftest` runs a short fit
 with the server up, scrapes itself, asserts every subsystem's counters
@@ -33,9 +41,13 @@ from .registry import (Counter, Gauge, Histogram, Registry, counter, gauge,
 from .exporter import TelemetryServer, get_server, start_server, stop_server
 from .steplog import StepLogger, enabled, log_event, maybe_step_logger
 from . import watchdog
+from . import tracing
+from . import flightrec
 from .watchdog import install as install_watchdog
+from .tracing import span, traced
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "counter", "gauge",
            "histogram", "get_registry", "TelemetryServer", "start_server",
            "stop_server", "get_server", "StepLogger", "maybe_step_logger",
-           "enabled", "log_event", "watchdog", "install_watchdog"]
+           "enabled", "log_event", "watchdog", "install_watchdog",
+           "tracing", "flightrec", "span", "traced"]

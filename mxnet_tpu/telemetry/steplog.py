@@ -107,6 +107,13 @@ class StepLogger:
         # subsystem counter baselines for per-step deltas
         self._ckpt_last = self._ckpt_counters()
         self._zero_last = self._zero_counters()
+        # run-scoped trace id: spans closing during this run carry it
+        # (tracing.set_step), so JSONL rows and timeline spans correlate
+        self.trace_id = "%012x" % int.from_bytes(os.urandom(6), "big")
+        self._trace_last = None
+        from . import tracing as _tracing
+        self._tracing = _tracing
+        _tracing.set_step(self.trace_id, 0)
         path = _log_path()
         if path:
             try:
@@ -114,7 +121,8 @@ class StepLogger:
             except OSError:
                 self._file = None
         self._emit({"event": "run_start", "phase": self.phase,
-                    "pid": os.getpid(), **(meta or {})})
+                    "pid": os.getpid(), "trace_id": self.trace_id,
+                    **(meta or {})})
 
     # -- subsystem sampling (host dicts only) -------------------------------
 
@@ -158,6 +166,41 @@ class StepLogger:
         except Exception:               # pragma: no cover
             return None
 
+    def _trace_sample(self, wall, n):
+        """Per-step phase breakdown from tracing's phase accumulators:
+        feed_us is consumer time BLOCKED on the feed ("feed" spans —
+        feeder-side staging records under "feed_stage" and does not
+        count), comm_us is time blocked in dist waits, so
+        1 - blocked/wall is a measured overlap fraction. Returns the
+        JSONL fields (None when MXNET_TRACE=0) and sets the overlap
+        gauges for /metrics."""
+        tr = self._tracing
+        tr.set_step(self.trace_id, n)
+        if not tr.enabled():
+            return None
+        totals = tr.phase_totals()
+        last = self._trace_last or {}
+        self._trace_last = totals
+
+        def delta(k):
+            return max(0, int(totals.get(k, 0) - last.get(k, 0)))
+
+        out = {"feed_us": delta("feed"), "compute_us": delta("compute"),
+               "comm_us": delta("comm"), "ckpt_us": delta("ckpt")}
+        wall_us = wall * 1e6
+        if wall_us > 0:
+            feed_ov = max(0.0, min(1.0, 1.0 - out["feed_us"] / wall_us))
+            comm_ov = max(0.0, min(1.0, 1.0 - out["comm_us"] / wall_us))
+            out["feed_compute_overlap_frac"] = round(feed_ov, 4)
+            out["comm_compute_overlap_frac"] = round(comm_ov, 4)
+            gauge("mxnet_trace_feed_compute_overlap_frac",
+                  help="1 - feed-blocked/wall over the last step "
+                       "window").set(out["feed_compute_overlap_frac"])
+            gauge("mxnet_trace_comm_compute_overlap_frac",
+                  help="1 - comm-blocked/wall over the last step "
+                       "window").set(out["comm_compute_overlap_frac"])
+        return out
+
     # -- recording ----------------------------------------------------------
 
     def step(self, samples=None, loss=None, steps=1, extra=None):
@@ -179,6 +222,7 @@ class StepLogger:
                 self._g_rate.set(round(samples / wall, 3))
         if loss is not None:
             self._g_loss.set(float(loss))
+        trace_fields = self._trace_sample(wall, n)
         if self._file is None:
             return
         amp_scale, amp_skipped = self._amp_sample()
@@ -195,6 +239,9 @@ class StepLogger:
                - self._ckpt_last["ckpt_save_us"],
                "ckpt_wait_us": ckpt["ckpt_wait_us"]
                - self._ckpt_last["ckpt_wait_us"]}
+        if trace_fields:
+            rec["trace_id"] = self.trace_id
+            rec.update(trace_fields)
         zero = self._zero_counters()
         if zero is not None:
             last = self._zero_last or {"zero_wire_bytes": 0}

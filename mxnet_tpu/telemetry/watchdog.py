@@ -93,11 +93,21 @@ def dump_now(reason="on-demand", file=None):
                 targets.append((open(_state["path"], "a"), True))
             except OSError:
                 pass
+    # the flight-recorder tail shows what the threads were DOING in the
+    # last seconds, complementing the faulthandler stacks that show
+    # where they ARE now
+    try:
+        from . import flightrec
+        tail = "\n" + flightrec.tail_text(n=40, last_s=30.0) + "\n"
+    except Exception:                   # pragma: no cover
+        tail = ""
     for f, close in targets:
         try:
             f.write(header)
             f.flush()
             faulthandler.dump_traceback(file=f, all_threads=True)
+            if tail:
+                f.write(tail)
             f.flush()
         except Exception:               # pragma: no cover
             pass

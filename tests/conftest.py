@@ -76,6 +76,11 @@ _QUICK = {
     "test_telemetry.py::test_registry_absorbs_profiler_hooks_and_dedups",
     "test_telemetry.py::test_exporter_scrape_during_live_fit",
     "test_telemetry.py::test_watchdog_stall_dump_and_rearm",
+    "test_tracing.py::test_span_nesting_and_thread_stacks",
+    "test_tracing.py::test_event_ring_bound_and_drop_accounting",
+    "test_tracing.py::test_merge_aligns_clocks_and_names_victims",
+    "test_tracing.py::test_steplog_phase_fields_and_overlap_fracs",
+    "test_tracing.py::test_flightrec_ring_dump_and_tail",
     "test_zero.py::test_zero1_fp32_bit_identical",
     "test_zero.py::test_resume_across_stage_change",
     "test_analysis.py::test_repo_is_clean_under_strict",

@@ -1,0 +1,467 @@
+"""mxnet_tpu.telemetry.tracing + flightrec — distributed span tracing,
+cross-rank timeline merge, and the crash flight recorder (ISSUE 13).
+
+Quick tier: span nesting/thread exactness, the bounded chrome-event
+ring's drop accounting, synthetic 8-rank shard merge (clock alignment,
+quiet/slowest rank naming, valid chrome JSON), steplog per-step phase
+fields + overlap fractions, flight-recorder ring/dump/tail — all
+jax-free or cheap.
+
+Full tier adds: MXNET_TRACE=0 vs =1 bit-identical Module.fit (tracing
+must never perturb numerics), the excepthook auto-dump, and the
+watchdog dump carrying the flight tail.
+
+Slow tier (-m slow, Gloo backend): a real 2-rank gang with an injected
+SIGKILL — every rank leaves a black box, the launcher's triage and the
+merged trace timeline both name the victim.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.cluster import ClusterLauncher, cpu_collectives_available
+from mxnet_tpu.telemetry import flightrec, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gloo = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Every test starts and ends with empty rings and phase totals, and
+    leaves the process-wide ring capacity at its default."""
+    profiler.clear_events()
+    flightrec.reset()
+    tracing.reset_phase_totals()
+    yield
+    profiler.set_max_events(200000)
+    profiler.clear_events()
+    flightrec.reset()
+    tracing.reset_phase_totals()
+
+
+def _trace_events():
+    return [e for e in profiler.events_snapshot()
+            if e.get("cat", "").startswith("trace:")]
+
+
+# -- span core ---------------------------------------------------------------
+
+def test_span_nesting_and_thread_stacks(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    seen = {}
+
+    def worker():
+        with tracing.span("outer.t2", phase="compute"):
+            seen["t2"] = tracing.current_stack()
+
+    with tracing.span("outer", phase="compute", k=3):
+        with tracing.span("inner", phase="feed"):
+            seen["nested"] = tracing.current_stack()
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert tracing.current_stack() == ()
+    assert seen["nested"] == ("outer", "inner")
+    # the worker thread's stack never saw this thread's open spans
+    assert seen["t2"] == ("outer.t2",)
+
+    byname = {e["name"]: e for e in _trace_events()}
+    assert set(byname) == {"outer", "inner", "outer.t2"}
+    outer, inner = byname["outer"], byname["inner"]
+    assert outer["ph"] == "X" and outer["cat"] == "trace:compute"
+    # child interval nests inside the parent's (1µs float slack)
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert byname["outer.t2"]["tid"] != outer["tid"]
+    assert byname["outer"]["args"]["k"] == 3
+    # exact phase accounting: 2 compute spans, 1 feed span
+    assert tracing.phase_counts() == {"compute": 2, "feed": 1}
+    totals = tracing.phase_totals()
+    assert totals["compute"] > 0 and totals["feed"] > 0
+
+
+def test_span_records_error_name_on_exception(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    with pytest.raises(ValueError):
+        with tracing.span("doomed", phase="compute"):
+            raise ValueError("boom")
+    (ev,) = _trace_events()
+    assert ev["args"]["error"] == "ValueError"
+    assert tracing.current_stack() == ()      # stack popped on the error
+
+
+def test_trace_off_is_a_shared_noop(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE", "0")
+    monkeypatch.setenv("MXNET_FLIGHTREC", "0")
+    s = tracing.span("ghost", phase="compute")
+    assert s is tracing.span("ghost2")        # one shared null instance
+    with s:
+        assert tracing.current_stack() == ()
+    tracing.event("ghost3", time.perf_counter(), phase="feed")
+    assert _trace_events() == []
+    assert tracing.phase_totals() == {}
+    assert flightrec.stats()["total"] == 0
+
+
+def test_retrospective_event_spans_interval(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    t0 = time.perf_counter()
+    time.sleep(0.002)
+    tracing.event("queue.wait", t0, phase="serve", rows=4)
+    (ev,) = _trace_events()
+    assert ev["name"] == "queue.wait" and ev["cat"] == "trace:serve"
+    assert ev["dur"] >= 1500.0                # at least ~1.5ms of the 2ms
+    assert ev["args"]["rows"] == 4
+
+
+# -- bounded event ring ------------------------------------------------------
+
+def test_event_ring_bound_and_drop_accounting(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    profiler.set_max_events(16)
+    profiler.clear_events()
+    for i in range(50):
+        with tracing.span(f"burst{i}", phase="compute"):
+            pass
+    snap = profiler.events_snapshot()
+    assert len(snap) == 16
+    assert profiler.dropped_events() == 34
+    # the survivors are the NEWEST events
+    assert snap[-1]["name"] == "burst49"
+
+
+def test_shard_dump_metadata(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    with tracing.span("real.step", phase="compute"):
+        time.sleep(0.001)
+    p = tracing.dump(path=str(tmp_path / "trace-rank-0.json"))
+    shard = json.loads(open(p, encoding="utf-8").read())
+    meta = shard["metadata"]
+    assert meta["rank"] == 0 and meta["version"] == 1
+    assert "clock_offset_us" in meta and "phase_totals_us" in meta
+    assert meta["dropped_events"] == 0
+    names = [e["name"] for e in shard["traceEvents"]]
+    assert "process_name" in names and "real.step" in names
+
+
+# -- merge -------------------------------------------------------------------
+
+def test_merge_aligns_clocks_and_names_victims(monkeypatch, tmp_path):
+    d = str(tmp_path / "shards")
+    tracing.synth_shards(d, ranks=8, steps=5, quiet_rank=3,
+                         quiet_after_step=1, slow_rank=5)
+    out, summary = tracing.merge(d)
+    m = json.loads(open(out, encoding="utf-8").read())
+    evs = m["traceEvents"]
+    assert isinstance(evs, list) and evs
+    # valid chrome-trace JSON: every event has ph+pid; complete events
+    # carry ts/dur/tid and normalized non-negative timestamps
+    assert all("ph" in e and "pid" in e for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(
+        e["ts"] >= 0 and "dur" in e and "tid" in e for e in xs)
+    assert sorted({e["pid"] for e in evs}) == list(range(8))
+    # per-rank clock offset (100s+17s/rank) and skew (1ms/rank) undone:
+    # the same step's feed spans land within 1µs across all 8 ranks
+    step0 = [e for e in xs
+             if (e.get("args") or {}).get("step") == 0
+             and e["cat"] == "trace:feed"]
+    assert len(step0) == 8
+    assert max(e["ts"] for e in step0) - min(e["ts"] for e in step0) < 1.0
+    assert summary["quiet_first"]["rank"] == 3
+    assert summary["slowest_rank_per_phase"]["compute"]["rank"] == 5
+    assert any(w["rank"] == 5 and w["phase"] == "compute"
+               for w in summary["critical_path"])
+    # the merge CLI (python -m mxnet_tpu.telemetry.tracing --merge /
+    # tools/trace_merge.py) drives the same path
+    assert tracing.main(["--merge", d,
+                         "--out", str(tmp_path / "cli.json")]) == 0
+    assert os.path.exists(tmp_path / "cli.json")
+
+
+def test_merge_skew_correction_uses_metadata(tmp_path):
+    # two ranks, same true timeline; rank 1's shard carries 1ms skew —
+    # merge must subtract it, not average it away
+    d = str(tmp_path / "two")
+    tracing.synth_shards(d, ranks=2, steps=1)
+    out, summary = tracing.merge(d)
+    assert summary["ranks"] == [0, 1]
+    assert summary["events"] == 6             # 3 phases x 2 ranks
+    assert summary["dropped_events"] == 0
+
+
+# -- steplog integration -----------------------------------------------------
+
+def test_steplog_phase_fields_and_overlap_fracs(monkeypatch, tmp_path):
+    from mxnet_tpu.telemetry import StepLogger
+    from mxnet_tpu.telemetry.registry import get_registry
+    log = tmp_path / "steps.jsonl"
+    monkeypatch.setenv("MXNET_TRACE", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_LOG", str(log))
+    slog = StepLogger("tracing_test")
+    with tracing.span("feed.wait", phase="feed"):
+        time.sleep(0.004)
+    with tracing.span("step.fused_dispatch", phase="compute"):
+        time.sleep(0.002)
+    with tracing.span("dist.allreduce", phase="comm"):
+        time.sleep(0.001)
+    slog.step(samples=8)
+    slog.close()
+
+    recs = [json.loads(line) for line in
+            open(log, encoding="utf-8").read().splitlines()]
+    (start,) = [r for r in recs if r["event"] == "run_start"]
+    (step,) = [r for r in recs if r["event"] == "step"]
+    assert start["trace_id"] == slog.trace_id
+    assert step["trace_id"] == slog.trace_id
+    # per-step phase breakdown, measured not estimated
+    assert step["feed_us"] >= 3000
+    assert step["compute_us"] >= 1500
+    assert step["comm_us"] >= 500
+    assert step["ckpt_us"] == 0
+    for k in ("feed_compute_overlap_frac", "comm_compute_overlap_frac"):
+        assert 0.0 <= step[k] <= 1.0
+    # the step blocked ~4ms on feed out of ~7ms wall: overlap well < 1
+    assert step["feed_compute_overlap_frac"] < 1.0
+    # the same fractions ride /metrics as gauges
+    reg = get_registry()
+    g = reg.get("mxnet_trace_feed_compute_overlap_frac")
+    assert g is not None and \
+        g.value() == step["feed_compute_overlap_frac"]
+    # spans closing during the run carried the run's trace id
+    ev = [e for e in _trace_events() if e["name"] == "feed.wait"][0]
+    assert ev["args"]["trace_id"] == slog.trace_id
+
+
+def test_steplog_no_trace_fields_when_off(monkeypatch, tmp_path):
+    from mxnet_tpu.telemetry import StepLogger
+    log = tmp_path / "steps.jsonl"
+    monkeypatch.setenv("MXNET_TRACE", "0")
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_LOG", str(log))
+    slog = StepLogger("tracing_off")
+    slog.step(samples=8)
+    slog.close()
+    (step,) = [json.loads(line) for line in
+               open(log, encoding="utf-8").read().splitlines()
+               if '"step"' in line and '"event": "step"' in line]
+    assert "feed_us" not in step and "trace_id" not in step
+
+
+# -- bit-identical fit -------------------------------------------------------
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _fit_params(trace_flag):
+    os.environ["MXNET_TRACE"] = trace_flag
+    try:
+        mx.random.seed(7)
+        np.random.seed(7)
+        rng = np.random.RandomState(0)
+        X = rng.uniform(-1, 1, (160, 8)).astype(np.float32)
+        Y = rng.randint(0, 4, (160,)).astype(np.float32)
+        it = mx.io.NDArrayIter(X, Y, batch_size=40, shuffle=False)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(0))
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Xavier())
+        args, _ = mod.get_params()
+        return {n: a.asnumpy() for n, a in args.items()}
+    finally:
+        os.environ.pop("MXNET_TRACE", None)
+
+
+def test_fit_bit_identical_trace_on_vs_off():
+    """Tracing must never perturb numerics: params after fit with
+    MXNET_TRACE=1 equal the MXNET_TRACE=0 run bit-for-bit (spans are
+    host-side wall-clock reads only — no device syncs, no extra
+    dispatches)."""
+    profiler.clear_events()
+    off = _fit_params("0")
+    n_off = len(_trace_events())
+    on = _fit_params("1")
+    assert n_off == 0                         # off -> zero trace events
+    assert len(_trace_events()) > 0           # on -> the fit was traced
+    assert set(on) == set(off)
+    for n in on:
+        np.testing.assert_array_equal(on[n], off[n], err_msg=n)
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flightrec_ring_dump_and_tail(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_FLIGHTREC", "1")
+    monkeypatch.setenv("MXNET_FLIGHTREC_EVENTS", "32")
+    for i in range(50):
+        flightrec.record("event", f"beat{i}", step=i)
+    st = flightrec.stats()
+    assert st["events"] == 32 and st["total"] == 50
+    assert st["dropped"] == 18 and st["capacity"] == 32
+    p = flightrec.dump(path=str(tmp_path / "fr.json"), reason="test")
+    box = json.loads(open(p, encoding="utf-8").read())
+    assert box["reason"] == "test" and box["rank"] == 0
+    assert len(box["events"]) == 32 and box["dropped"] == 18
+    assert box["last_event_t"] == box["events"][-1]["t"]
+    tail = flightrec.tail_text(n=5)
+    assert "beat49" in tail and "beat44" not in tail
+
+
+def test_flightrec_disabled_records_nothing(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_FLIGHTREC", "0")
+    flightrec.record("event", "ghost")
+    assert flightrec.stats()["total"] == 0
+    assert flightrec.dump(path=str(tmp_path / "no.json")) is None
+    assert not (tmp_path / "no.json").exists()
+
+
+def test_flightrec_excepthook_dumps_blackbox(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_FLIGHTREC", "1")
+    prev_hook = sys.excepthook
+    assert flightrec.install(directory=str(tmp_path))
+    try:
+        flightrec.record("event", "last_breath")
+        try:
+            raise RuntimeError("simulated crash")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        box_path = tmp_path / "flightrec-rank-0.json"
+        assert box_path.exists()
+        box = json.loads(box_path.read_text(encoding="utf-8"))
+        assert box["reason"].startswith("uncaught exception: RuntimeError")
+        names = [e["name"] for e in box["events"]]
+        assert "last_breath" in names
+        assert "uncaught:RuntimeError" in names
+    finally:
+        flightrec.uninstall()
+    assert sys.excepthook is prev_hook
+
+
+def test_watchdog_dump_carries_flight_tail(monkeypatch, tmp_path):
+    from mxnet_tpu.telemetry import watchdog
+    monkeypatch.setenv("MXNET_FLIGHTREC", "1")
+    flightrec.record("span", "ckpt.seal", dur_us=1234, step=7)
+    out = tmp_path / "dump.txt"
+    with open(out, "w", encoding="utf-8") as f:
+        watchdog.dump_now(reason="test-stall", file=f)
+    text = out.read_text(encoding="utf-8")
+    # faulthandler stacks show where threads ARE; the flight tail shows
+    # what they were DOING
+    assert "watchdog: test-stall" in text
+    assert "flight recorder tail" in text
+    assert "ckpt.seal" in text and "1.234ms" in text
+
+
+# -- launcher triage (no jax: black boxes are plain JSON) --------------------
+
+def _fake_box(rank, t_last, n=5):
+    return {"version": 1, "rank": rank, "pid": 1000 + rank,
+            "reason": "periodic-flush", "wall_time": t_last,
+            "events": [{"t": t_last - (n - 1 - i) * 0.1,
+                        "thr": "MainThread", "kind": "span",
+                        "name": f"r{rank}.ev{i}", "dur_us": 42}
+                       for i in range(n)],
+            "dropped": 0, "total": n, "last_event_t": t_last}
+
+
+def test_cluster_result_quiet_rank_and_triage(tmp_path):
+    base = 1700000000.0
+    boxes = {0: _fake_box(0, base + 10.0),
+             1: _fake_box(1, base + 4.0),     # went quiet 6s earlier
+             2: _fake_box(2, base + 9.8)}
+    launcher = ClusterLauncher(nprocs=3, blackbox_dir=str(tmp_path))
+    for r, b in boxes.items():
+        (tmp_path / f"flightrec-rank-{r}.json").write_text(
+            json.dumps(b), encoding="utf-8")
+    collected = launcher.collect_blackboxes()
+    assert sorted(collected) == [0, 1, 2]
+    from mxnet_tpu.cluster.launcher import ClusterResult
+
+    class _RP:
+        def __init__(self, rank, rc):
+            self.rank, self.exit_rc, self.exit_t = rank, rc, 1.0
+            self.reaped = False
+
+        def log_text(self):
+            return ""
+
+    ranks = [_RP(0, 1), _RP(1, -9), _RP(2, 1)]
+    res = ClusterResult(ranks, 12.0, False, 0.5, 0.0,
+                        blackboxes=collected,
+                        blackbox_dir=str(tmp_path))
+    assert res.quiet_rank == 1
+    text = res.triage(last_s=20.0)
+    assert "rank 1 went quiet FIRST" in text
+    assert "r0.ev4" in text and "r1.ev4" in text
+    # interleaved and time-ordered: rank 1's newest event prints before
+    # rank 0's newest (it is 6s older)
+    assert text.index("r1.ev4") < text.index("r0.ev4")
+
+
+# -- the real thing: 2-rank gang, injected SIGKILL ---------------------------
+
+_TRACED_WORKER = r"""
+import os, time
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+assert dist.is_initialized()
+for i in range(6):
+    dist.barrier(f"traced_{i}")
+    time.sleep(0.3)      # give the 0.5s flushers time to land a snapshot
+print("worker done", rank, flush=True)
+"""
+
+
+@pytest.mark.slow
+@needs_gloo
+def test_two_rank_kill_leaves_blackboxes_and_merged_timeline(tmp_path):
+    """End-to-end DistRankFailure postmortem: rank 1 is SIGKILLed at its
+    3rd barrier; the survivor aborts with a named DistRankFailure; BOTH
+    ranks leave flight-recorder black boxes; the launcher triage and the
+    merged span timeline each name rank 1 as the one that went quiet."""
+    trace_dir = str(tmp_path / "trace")
+    victim = 1
+    launcher = ClusterLauncher(
+        nprocs=2, deadline_s=90.0, dist_timeout_s=5.0, dist_retries=0,
+        inject=f"kill@pre-barrier:{victim}@3", stream=False,
+        blackbox_dir=str(tmp_path / "blackbox"),
+        env={"PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", ""),
+             "MXNET_TELEMETRY": "0",
+             "MXNET_TRACE": "1", "MXNET_TRACE_DIR": trace_dir,
+             "MXNET_TRACE_FLUSH_S": "0.5"})
+    res = launcher.launch_python(_TRACED_WORKER)
+    assert not res.ok
+    assert not res.deadline_fired, res.describe()
+    assert res.returncodes[victim] == -9
+    assert "DistRankFailure" in res.tails[0] \
+        or "JAX distributed service detected fatal errors" in res.tails[0]
+    # every rank's black box was collected; the victim is the quiet one
+    assert sorted(res.blackboxes) == [0, 1], res.describe()
+    assert res.quiet_rank == victim
+    assert f"rank {victim} went quiet FIRST" in res.triage()
+    # the per-rank shards merge into one valid timeline naming the victim
+    out, summary = tracing.merge(trace_dir)
+    merged = json.loads(open(out, encoding="utf-8").read())
+    assert isinstance(merged["traceEvents"], list)
+    assert all("ph" in e and "pid" in e for e in merged["traceEvents"])
+    assert summary["quiet_first"]["rank"] == victim

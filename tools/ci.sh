@@ -46,6 +46,9 @@ case "$stage" in
     echo "== telemetry smoke (registry/scrape/JSONL/overhead/watchdog)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.telemetry --selftest
+    echo "== tracing smoke (spans/ring/shard merge/flight recorder)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.telemetry.tracing --selftest
     echo "== cluster smoke (2-proc gang: barrier, kill injection, resume)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.cluster --selftest --nprocs 2

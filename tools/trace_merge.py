@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Merge per-rank trace shards into one pod timeline.
+
+    python tools/trace_merge.py TRACE_DIR [--out merged.json]
+    python tools/trace_merge.py trace-rank-0.json trace-rank-1.json ...
+
+Thin CLI over `mxnet_tpu.telemetry.tracing --merge`: aligns every
+rank's `trace-rank-K.json` (written when MXNET_TRACE=1) onto rank 0's
+wall timebase using the clock offsets/skews recorded in each shard,
+fuses them into one perfetto/chrome-tracing loadable JSON, and prints
+the critical-path summary — slowest rank per phase per step, and which
+rank went quiet first.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.telemetry import tracing          # noqa: E402
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    # reuse the module CLI verbatim; everything here is just --merge
+    if "--merge" not in argv:
+        argv = ["--merge", *argv]
+    return tracing.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
