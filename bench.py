@@ -17,8 +17,10 @@ lower bound (training is ~3x the FLOPs of inference). The exact
 inference-vs-inference ratio is reported as `inference_vs_baseline`.
 
 MFU accounting: model FLOPs are read from XLA's own cost analysis of the
-compiled step executable (compile().cost_analysis()['flops']) — NOT a
-hand-maintained constant. ResNet-50 fwd is 4.09 GMACs = 8.18 GFLOPs/img
+compiled step executable, via telemetry.devstats.extract — the framework's
+single home of executable introspection, which also hands each lane its
+plan-memory columns (peak / argument / accessed bytes, `plan_memory` in
+the summary and on the lane lines) — NOT a hand-maintained constant. ResNet-50 fwd is 4.09 GMACs = 8.18 GFLOPs/img
 (2 FLOPs per MAC); a full training step measures ~23.8 GFLOP/img (fwd +
 grad-weights + grad-activations; the data tensor gets no gradient). Round-2
 reported half the true MFU by using the GMAC figure as if it were FLOPs —
@@ -178,7 +180,8 @@ def _train_ips_quick(sym, mesh, dtype, batch, steps=10):
                                                     inputs)
     float(loss)
     flops = _cost_flops(trainer._step, params, states, aux, inputs,
-                        trainer._rng_dev, trainer._lr_dev, trainer._t_dev)
+                        trainer._rng_dev, trainer._lr_dev, trainer._t_dev,
+                        lane="train_resnet152")
     if QUICK:
         steps = min(steps, 3)
     rates = []
@@ -254,7 +257,8 @@ def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
                                                     inputs)
     float(loss)
     flops = _cost_flops(trainer._step, params, states, aux, inputs,
-                        trainer._rng_dev, trainer._lr_dev, trainer._t_dev)
+                        trainer._rng_dev, trainer._lr_dev, trainer._t_dev,
+                        lane="lstm_lm")
     n_disp, rates = 64 // k, []
     n_single = 3 if QUICK else 10
     for _ in range(1 if QUICK else 3):
@@ -275,16 +279,34 @@ def _lstm_tokens_per_sec(mesh, batch=32, seq=64, hidden=512, vocab=10000,
         flops / (batch * seq) if flops else None, single_tps   # per token
 
 
-def _cost_flops(jitted, *args):
-    """Model FLOPs of a compiled executable, from XLA's cost analysis.
-    Returns None if the backend doesn't support it."""
+PLAN_MEM = {}        # lane -> plan-memory columns (devstats extraction)
+LANE_TIMES = {}      # lane -> {est_s, actual_s, err_s} (budget accounting)
+
+
+def _plan_stats(lane, jitted, *args):
+    """XLA cost/memory analytics of a compiled lane executable via
+    telemetry.devstats.extract (the single home of executable
+    introspection). Side effect: PLAN_MEM[lane] gets the lane's
+    plan-memory columns (peak / argument / accessed bytes) for the lane
+    line and the summary. Returns model FLOPs, or None if the backend
+    doesn't support cost analysis."""
     try:
-        ca = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        return float(ca["flops"])
+        from mxnet_tpu.telemetry import devstats
+        stats = devstats.extract(jitted.lower(*args).compile())
+        PLAN_MEM[lane] = {
+            "plan_peak_bytes": int(stats["peak_bytes"]),
+            "plan_argument_bytes": int(stats["argument_bytes"]),
+            "plan_bytes_accessed": int(stats["bytes_accessed"]),
+        }
+        return float(stats["flops"]) or None
     except Exception:
         return None
+
+
+def _cost_flops(jitted, *args, lane=None):
+    """Model FLOPs of a compiled executable, from XLA's cost analysis.
+    Returns None if the backend doesn't support it."""
+    return _plan_stats(lane or "unnamed", jitted, *args)
 
 
 def _train_ips(sym, mesh, dtype, want_flops=False, k=4):
@@ -331,7 +353,8 @@ def _train_ips(sym, mesh, dtype, want_flops=False, k=4):
         # under-report by K
         step_flops = _cost_flops(trainer._step, params, states, aux,
                                  inputs1, trainer._rng_dev,
-                                 trainer._lr_dev, trainer._t_dev)
+                                 trainer._lr_dev, trainer._t_dev,
+                                 lane="train_resnet50")
     # median of 3 trials: the shared chip/tunnel shows transient
     # contention windows (3-4x inflation observed); the median resists a
     # single bad window without the upward bias of best-of
@@ -368,7 +391,8 @@ def _infer_ips(run, argv, aux, key, want_flops=False):
     # reliable completion barrier here
     np.asarray(infer(argv, aux, key))
     # cost_analysis pays a second AOT compile — only when asked for
-    flops = _cost_flops(infer, argv, aux, key) if want_flops else None
+    flops = _cost_flops(infer, argv, aux, key,
+                        lane="inference_resnet50") if want_flops else None
     n_inf, inf_rates = (10 if (QUICK or CPU_SCALE) else 50), []
     for _ in range(1 if QUICK else 3):  # median against tunnel contention
         t0 = time.perf_counter()
@@ -1386,12 +1410,18 @@ def main(argv=None):
         try:
             out = fn(*fargs, **fkw)
         except BaseException as e:
+            lane_s = round(time.monotonic() - t0, 1)
+            LANE_TIMES[name] = {"est_s": est_s, "actual_s": lane_s,
+                                "err_s": round(lane_s - est_s, 1)}
             _heartbeat(name, "lane_end", ok=False,
-                       error=type(e).__name__,
-                       lane_s=round(time.monotonic() - t0, 1))
+                       error=type(e).__name__, lane_s=lane_s)
             raise
-        _heartbeat(name, "lane_end", ok=True,
-                   lane_s=round(time.monotonic() - t0, 1))
+        lane_s = round(time.monotonic() - t0, 1)
+        # estimate-vs-actual error feeds the summary's budget accounting
+        # (a lane whose estimate drifts is what sheds later lanes)
+        LANE_TIMES[name] = {"est_s": est_s, "actual_s": lane_s,
+                            "err_s": round(lane_s - est_s, 1)}
+        _heartbeat(name, "lane_end", ok=True, lane_s=lane_s)
         return out
 
     sym = _resnet50_symbol()
@@ -1411,7 +1441,8 @@ def main(argv=None):
     _emit("train_resnet50", {"bf16_ips": round(train_ips, 2),
                              "mfu": round(mfu, 4),
                              "fp32_ips": round(fp32_ips, 2)
-                             if fp32_ips is not None else None})
+                             if fp32_ips is not None else None,
+                             **PLAN_MEM.get("train_resnet50", {})})
 
     # -- inference (exact baseline config: batch 32), fp32 and bf16 ----------
     _heartbeat("inference_resnet50", "lane_start")
@@ -1436,7 +1467,8 @@ def main(argv=None):
     infer_mfu = infer16_ips * infer_flops_img / V5E_PEAK_FLOPS
     _emit("inference_resnet50", {"fp32_b32_ips": round(infer_ips, 2),
                                  "bf16_b32_ips": round(infer16_ips, 2),
-                                 "bf16_mfu": round(infer_mfu, 4)})
+                                 "bf16_mfu": round(infer_mfu, 4),
+                                 **PLAN_MEM.get("inference_resnet50", {})})
 
     # secondary lanes, each guarded: failures must not discard the
     # flagship numbers measured above. Every lane reports its model
@@ -1462,7 +1494,8 @@ def main(argv=None):
         rn152_ips, rn152_mfu = "skipped: budget", None
     except Exception as e:
         rn152_ips, rn152_mfu = f"unavailable: {type(e).__name__}", None
-    _emit("train_resnet152", {"ips_b64": rn152_ips, "mfu": rn152_mfu})
+    _emit("train_resnet152", {"ips_b64": rn152_ips, "mfu": rn152_mfu,
+                              **PLAN_MEM.get("train_resnet152", {})})
     try:
         if CPU_SCALE:   # bf16 LSTM is software-emulated on cpu — chip lane
             raise _ChipOnly()
@@ -1478,7 +1511,8 @@ def main(argv=None):
     except Exception as e:
         lstm_tps, lstm_mfu = f"unavailable: {type(e).__name__}", None
         lstm_single_tps = None
-    _emit("lstm_lm", {"tokens_per_sec": lstm_tps, "mfu": lstm_mfu})
+    _emit("lstm_lm", {"tokens_per_sec": lstm_tps, "mfu": lstm_mfu,
+                      **PLAN_MEM.get("lstm_lm", {})})
     try:
         if CPU_SCALE:   # ~5 TFLOP/step Pallas kernel — chip lane
             raise _ChipOnly()
@@ -1671,6 +1705,18 @@ def main(argv=None):
         "quick": QUICK,
         "budget_s": BENCH_BUDGET_S,
         "elapsed_s": round(time.monotonic() - _T_START, 1),
+        # what was left of BENCH_BUDGET_S at summary time (negative =
+        # the run overran; the driver's kill margin is visible here)
+        "budget_headroom_s": round(_budget_left(), 1),
+        # per-lane estimate-vs-actual duration error for the gated
+        # lanes: positive err_s means the lane ran past its estimate —
+        # the drift that sheds later lanes
+        "lane_duration_error_s": {
+            name: t["err_s"] for name, t in sorted(LANE_TIMES.items())},
+        "lane_times_s": LANE_TIMES,
+        # per-lane plan-memory columns (devstats extraction of each
+        # lane's compiled executable; also on the lane lines above)
+        "plan_memory": PLAN_MEM,
         "inference_b32_ips": round(infer_ips, 2),
         "inference_bf16_b32_ips": round(infer16_ips, 2),
         "inference_bf16_mfu": round(infer_mfu, 4),

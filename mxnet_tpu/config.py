@@ -161,6 +161,21 @@ _DOCUMENTED = {
     # strict by default (exit non-zero on unsuppressed P0/P1)
     "MXNET_ANALYSIS_BASELINE": None,
     "MXNET_ANALYSIS_STRICT": 0,
+    # device-efficiency observability (telemetry/devstats.py,
+    # docs/TELEMETRY.md): MXNET_DEVSTATS=0 disables XLA cost/memory
+    # extraction, MFU/roofline step fields, HBM preflight and the
+    # recompile sentinel (default on; off is bit-identical);
+    # _PEAK_TFLOPS/_PEAK_GBPS override the per-backend hardware peak
+    # table MFU/roofline divide by; _HBM_BYTES pins the device memory
+    # budget the preflight checks against (autodetected from PJRT
+    # memory_stats where the backend exposes it — cpu does not);
+    # _RECOMPILE_LIMIT is the per-program compile count past which the
+    # sentinel warns + flight-records a recompile storm (<=0 disables)
+    "MXNET_DEVSTATS": 1,
+    "MXNET_DEVSTATS_PEAK_TFLOPS": None,
+    "MXNET_DEVSTATS_PEAK_GBPS": None,
+    "MXNET_DEVSTATS_HBM_BYTES": None,
+    "MXNET_DEVSTATS_RECOMPILE_LIMIT": 32,
 }
 
 

@@ -45,7 +45,7 @@ def serving_buckets(max_batch):
 
 
 def export_model(path, symbol, arg_params, aux_params, data_shapes,
-                 dtype="float32", platforms=None):
+                 dtype="float32", platforms=None, model_name=None):
     """Serialize an inference-ready model to `path` (.mxa artifact).
 
     data_shapes: {input_name: shape} for every non-parameter argument
@@ -53,7 +53,11 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     "bfloat16" casts weight/input matrices at the use sites the same way
     the bf16 inference bench lane does. `platforms` defaults to
     ("cpu", "tpu") so one artifact serves both; lowering for a platform
-    does not require its hardware.
+    does not require its hardware. `model_name` labels the artifact for
+    serving metrics (defaults to the artifact's file stem); the manifest
+    additionally records the program's XLA cost/memory analytics under
+    "devstats" (telemetry.devstats — FLOPs, arg/output/temp bytes, peak
+    estimate), so capacity planning can read footprints offline.
     """
     import jax
     import jax.numpy as jnp
@@ -170,8 +174,12 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
         serving_meta = {"batch_axis": 0, "max_batch": max_batch,
                         "buckets": serving_buckets(max_batch),
                         "amp_dtype": dtype}
+    if model_name is None:
+        model_name = os.path.splitext(os.path.basename(str(path)))[0] \
+            or "model"
     manifest = {
         "format_version": FORMAT_VERSION,
+        "model_name": str(model_name),
         "inputs": [{"name": n, "shape": list(data_shapes[n]),
                     "dtype": "float32"} for n in input_names],
         "param_names": param_names,
@@ -182,6 +190,23 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     }
     if serving_meta is not None:
         manifest["serving"] = serving_meta
+    # export-funnel devstats: one AOT compile of the inference program
+    # for its cost/memory analytics — export is offline, the extra
+    # compile is fine, and the manifest gets the per-program footprint
+    from ..telemetry import devstats
+    if devstats.enabled():
+        try:
+            compiled = jax.jit(fn).lower(
+                *in_specs, *par_specs, *aux_specs, rng_spec).compile()
+            stats = devstats.record_program(
+                "export.%s" % model_name, compiled=compiled, kind="export")
+            manifest["devstats"] = {
+                k: stats[k] for k in
+                ("flops", "bytes_accessed", "argument_bytes",
+                 "output_bytes", "temp_bytes", "generated_code_bytes",
+                 "peak_bytes")}
+        except Exception:
+            pass            # analytics are best-effort; the artifact isn't
     with tempfile.TemporaryDirectory() as td:
         pfile = os.path.join(td, PARAMS_FILE)
         # container.save_container takes raw numpy directly

@@ -263,7 +263,13 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
                     ckpt_mgr.wait()
                     raise SystemExit(143)
     finally:
-        slog.close()
+        # run_end carries the step program's XLA cost digest (program
+        # name, FLOPs/bytes per step, the peak table the MFU used)
+        from ..telemetry import devstats as _devstats
+        try:
+            slog.close(**_devstats.fit_summary())
+        except Exception:
+            slog.close()
         if ckpt_mgr is not None:
             ckpt_mgr.remove_sigterm_hook()
             ckpt_mgr.close()

@@ -659,7 +659,14 @@ class Module(BaseModule):
                                          epoch, name, val)
                 train_data.reset()
         finally:
-            slog.close()
+            # run_end carries the step program's XLA cost digest (which
+            # program the per-step MFU was measured against, its
+            # FLOPs/bytes per step, the peak table in force)
+            from ..telemetry import devstats as _devstats
+            try:
+                slog.close(**_devstats.fit_summary())
+            except Exception:
+                slog.close()
             if ckpt_mgr is not None:
                 ckpt_mgr.remove_sigterm_hook()
                 ckpt_mgr.close()

@@ -597,13 +597,18 @@ class DataParallelTrainer:
 
     def step(self, params, states, aux, inputs, rng=None):
         self._ensure_dev_state(rng)
+        from ..telemetry import devstats
         if self._has_ls:
-            out = self._step(params, states, aux, inputs, self._rng_dev,
-                             self._lr_dev, self._t_dev, self._ls_dev)
+            args = (params, states, aux, inputs, self._rng_dev,
+                    self._lr_dev, self._t_dev, self._ls_dev)
+            devstats.on_dispatch("dp.step", self._step, args, steps=1)
+            out = self._step(*args)
             self._ls_dev = out[7]
         else:
-            out = self._step(params, states, aux, inputs, self._rng_dev,
-                             self._lr_dev, self._t_dev)
+            args = (params, states, aux, inputs, self._rng_dev,
+                    self._lr_dev, self._t_dev)
+            devstats.on_dispatch("dp.step", self._step, args, steps=1)
+            out = self._step(*args)
         # rng/t are device-carried (split/incremented inside the step): the
         # host never dispatches per-step key splits or scalar transfers
         self._rng_dev, self._t_dev = out[5], out[6]
@@ -635,12 +640,17 @@ class DataParallelTrainer:
         self._ensure_dev_state(rng)
         k = int(inputs[0].shape[0])
         fn = self._multi_step_fn(k, outputs_mode, unroll)
+        from ..telemetry import devstats
         if self._has_ls:
-            out = fn(params, states, aux, inputs, self._rng_dev,
-                     self._lr_dev, self._t_dev, self._ls_dev)
+            args = (params, states, aux, inputs, self._rng_dev,
+                    self._lr_dev, self._t_dev, self._ls_dev)
+            devstats.on_dispatch("dp.step_k%d" % k, fn, args, steps=k)
+            out = fn(*args)
             self._ls_dev = out[7]
         else:
-            out = fn(params, states, aux, inputs, self._rng_dev,
-                     self._lr_dev, self._t_dev)
+            args = (params, states, aux, inputs, self._rng_dev,
+                    self._lr_dev, self._t_dev)
+            devstats.on_dispatch("dp.step_k%d" % k, fn, args, steps=k)
+            out = fn(*args)
         self._rng_dev, self._t_dev = out[5], out[6]
         return out[:5]

@@ -197,20 +197,29 @@ class ZeroLayout:
         off = self.offsets[b][self.buckets[b].index(i)]
         return min(off // self.shard_len[b], self.n_dev - 1)
 
-    def wire_bytes_per_step(self, stage, compute_itemsize, wire_itemsize):
-        """Analytic per-device wire bytes of one step (ring collective
-        accounting: all-gather/reduce-scatter move (N-1)/N of the global
-        buffer per device, all-reduce twice that). The HLO-measured
-        numbers come from hloaudit.spmd_collectives; this feeds the live
-        `zero_wire_bytes` telemetry counter without a device sync."""
+    def wire_bytes_breakdown(self, stage, compute_itemsize, wire_itemsize):
+        """(param all-gather bytes, grad-reduce bytes) per device per step
+        (ring collective accounting: all-gather/reduce-scatter move
+        (N-1)/N of the global buffer per device, all-reduce twice that).
+        The per-stage split telemetry.devstats pairs with the step
+        program's FLOPs for roofline accounting."""
         n = self.n_dev
         frac = (n - 1) / n
-        total = 0.0
+        ag = red = 0.0
         for p in self.padded:
-            total += p * frac * compute_itemsize            # all-gather
-            red = p * frac * wire_itemsize                  # grad reduce
-            total += red if stage >= 2 else 2 * red         # ar = 2x rs
-        return int(total)
+            ag += p * frac * compute_itemsize               # all-gather
+            r = p * frac * wire_itemsize                    # grad reduce
+            red += r if stage >= 2 else 2 * r               # ar = 2x rs
+        return int(ag), int(red)
+
+    def wire_bytes_per_step(self, stage, compute_itemsize, wire_itemsize):
+        """Analytic per-device wire bytes of one step — the breakdown's
+        sum. The HLO-measured numbers come from hloaudit.spmd_collectives;
+        this feeds the live `zero_wire_bytes` telemetry counter without a
+        device sync."""
+        ag, red = self.wire_bytes_breakdown(stage, compute_itemsize,
+                                            wire_itemsize)
+        return ag + red
 
     def overlap_frac(self):
         """Fraction of grad-reduce bytes whose bucket collective can
@@ -236,6 +245,8 @@ class ZeroLayout:
 # -- live counter export (profiler hook "zero", scraped by telemetry) --------
 
 _COUNTERS = {"zero_wire_bytes": 0, "zero_steps": 0,
+             "zero_wire_allgather_bytes": 0, "zero_wire_reduce_bytes": 0,
+             "zero_flops_per_step": 0.0,
              "zero_overlap_frac": 0.0, "zero_stage": 0,
              "zero_buckets": 0, "zero_compress_bits": 32}
 _HOOKED = False
@@ -644,30 +655,43 @@ class ZeroTrainer(DataParallelTrainer):
 
     def _tick_counters(self, k):
         L = self._layout
-        wire = L.wire_bytes_per_step(self._zero_stage,
-                                     self._compute_itemsize,
-                                     self._wire_itemsize)
-        _COUNTERS["zero_wire_bytes"] += wire * int(k)
+        ag, red = L.wire_bytes_breakdown(self._zero_stage,
+                                         self._compute_itemsize,
+                                         self._wire_itemsize)
+        _COUNTERS["zero_wire_bytes"] += (ag + red) * int(k)
+        _COUNTERS["zero_wire_allgather_bytes"] += ag * int(k)
+        _COUNTERS["zero_wire_reduce_bytes"] += red * int(k)
         _COUNTERS["zero_steps"] += int(k)
         _COUNTERS["zero_overlap_frac"] = L.overlap_frac()
         _COUNTERS["zero_stage"] = self._zero_stage
         _COUNTERS["zero_buckets"] = L.n_buckets
         _COUNTERS["zero_compress_bits"] = self._wire_itemsize * 8
+        # XLA-reported FLOPs of the active zero step program (devstats
+        # async extraction; 0 until the first extraction lands)
+        from ..telemetry import devstats
+        costs = devstats.step_costs()
+        if costs["flops"] > 0 and str(costs["name"]).startswith("zero"):
+            _COUNTERS["zero_flops_per_step"] = costs["flops"]
 
     def step(self, params, states, aux, inputs, rng=None):
         if self._zstep is None:
             raise MXNetError("ZeroTrainer.step before init_state/"
                              "import_training_state")
         self._ensure_dev_state(rng)
+        from ..telemetry import devstats
+        name = "zero%d.step" % self._zero_stage
         if self._has_ls:
-            out = self._zstep(params, states, self._resid_dev, aux,
-                              inputs, self._rng_dev, self._lr_dev,
-                              self._t_dev, self._ls_dev)
+            args = (params, states, self._resid_dev, aux, inputs,
+                    self._rng_dev, self._lr_dev, self._t_dev,
+                    self._ls_dev)
+            devstats.on_dispatch(name, self._zstep, args, steps=1)
+            out = self._zstep(*args)
             self._ls_dev = out[8]
         else:
-            out = self._zstep(params, states, self._resid_dev, aux,
-                              inputs, self._rng_dev, self._lr_dev,
-                              self._t_dev)
+            args = (params, states, self._resid_dev, aux, inputs,
+                    self._rng_dev, self._lr_dev, self._t_dev)
+            devstats.on_dispatch(name, self._zstep, args, steps=1)
+            out = self._zstep(*args)
         self._resid_dev = out[2]
         self._rng_dev, self._t_dev = out[6], out[7]
         self._tick_counters(1)
@@ -681,14 +705,20 @@ class ZeroTrainer(DataParallelTrainer):
         self._ensure_dev_state(rng)
         k = int(inputs[0].shape[0])
         fn = self._zero_multi_fn(k, outputs_mode, unroll)
+        from ..telemetry import devstats
+        name = "zero%d.step_k%d" % (self._zero_stage, k)
         if self._has_ls:
-            out = fn(params, states, self._resid_dev, aux, inputs,
-                     self._rng_dev, self._lr_dev, self._t_dev,
-                     self._ls_dev)
+            args = (params, states, self._resid_dev, aux, inputs,
+                    self._rng_dev, self._lr_dev, self._t_dev,
+                    self._ls_dev)
+            devstats.on_dispatch(name, fn, args, steps=k)
+            out = fn(*args)
             self._ls_dev = out[8]
         else:
-            out = fn(params, states, self._resid_dev, aux, inputs,
-                     self._rng_dev, self._lr_dev, self._t_dev)
+            args = (params, states, self._resid_dev, aux, inputs,
+                    self._rng_dev, self._lr_dev, self._t_dev)
+            devstats.on_dispatch(name, fn, args, steps=k)
+            out = fn(*args)
         self._resid_dev = out[2]
         self._rng_dev, self._t_dev = out[6], out[7]
         self._tick_counters(k)

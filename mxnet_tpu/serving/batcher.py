@@ -119,7 +119,9 @@ class DynamicBatcher:
         self.max_wait_s = max_wait_us / 1e6
         self.queue_depth = int(queue_depth)
         self.default_timeout_ms = default_timeout_ms
-        self.metrics = metrics or ServingMetrics()
+        self.metrics = metrics or ServingMetrics(
+            model=getattr(engine, "model_name", None))
+        self._sync_plan_bytes()
         self._q = deque()
         self._cond = threading.Condition()
         self._stopped = False
@@ -127,6 +129,16 @@ class DynamicBatcher:
                                         name="mxnet_tpu-serving-batcher",
                                         daemon=True)
         self._worker.start()
+
+    def _sync_plan_bytes(self):
+        """Mirror the engine's plan-cache footprint (devstats-measured
+        resident bytes per admitted bucket plan) into the metrics, so the
+        gauge tracks lazy bucket admits as infer() triggers them."""
+        resident = getattr(self.engine, "plan_resident_bytes", None)
+        if resident is not None:
+            plans = getattr(self.engine, "plan_bytes", None)
+            self.metrics.record_plan_bytes(
+                resident, plans=len(plans) if plans is not None else None)
 
     # -- client side --------------------------------------------------------
 
@@ -261,6 +273,7 @@ class DynamicBatcher:
                     r.future._set_exception(e)
                 continue
             self.metrics.record_batch(rows)
+            self._sync_plan_bytes()
             now = time.monotonic()
             off = 0
             for r in batch:

@@ -13,11 +13,19 @@ The engine takes the middle path the serving literature converged on
   - a ladder of power-of-two batch *buckets* up to the export batch
     (read from MANIFEST.json's `serving` block when present, derived
     otherwise);
-  - one compiled plan per bucket, built lazily and cached: a jitted
-    program that zero-pads the bucket batch up to the export batch ON
-    DEVICE, calls the exported StableHLO module, and slices outputs back
-    to the bucket — pad and slice are fused into the XLA program, so the
-    host only ever pads request->bucket (cheap numpy);
+  - one compiled plan per bucket, built lazily and cached: an
+    ahead-of-time compiled (``jit(fn).lower(specs).compile()``) program
+    that zero-pads the bucket batch up to the export batch ON DEVICE,
+    calls the exported StableHLO module, and slices outputs back to the
+    bucket — pad and slice are fused into the XLA program, so the host
+    only ever pads request->bucket (cheap numpy). The AOT ``Compiled``
+    object is the plan: dispatch never consults the jit cache (no
+    shape/commitment re-keying) and its cost/memory analytics feed
+    telemetry.devstats — per-plan FLOPs/bytes gauges on /metrics, a
+    total-resident-bytes account of the plan cache (`plan_resident_bytes`,
+    the eviction input), and an HBM preflight that rejects a bucket whose
+    estimated footprint will not fit the device memory budget *before*
+    it is admitted;
   - `warmup()` pre-compiles every bucket so no request pays a compile.
 
 Thread-safe: plan creation and device execution are serialized with an
@@ -75,7 +83,14 @@ class ServingEngine:
         self.buckets = ladder
         self.input_names = list(self._pred._input_names)
         self.output_names = list(self._pred.output_names)
+        # per-model metrics label (serving/metrics.py): recorded by
+        # contrib.export when the artifact was built with a name
+        self.model_name = str(man.get("model_name")
+                              or serving.get("model") or "model")
         self._plans = {}
+        self.plan_bytes = {}            # bucket -> resident-bytes estimate
+        self.plan_peak_bytes = {}       # bucket -> est. execution footprint
+        self.plan_resident_bytes = 0    # sum over cached plans (eviction input)
         self._lock = threading.RLock()
         self.plan_compiles = 0          # bucket plans built (cache misses)
         self.executions = 0             # compiled-plan invocations
@@ -130,10 +145,44 @@ class ServingEngine:
                          if getattr(o, "ndim", 0) and o.shape[0] == B
                          else o for o in outs)
 
-        plan = jax.jit(fn)
-        self._plans[bucket] = plan
+        # AOT: lower against this bucket's exact specs and keep the
+        # Compiled object itself as the plan. Compiled is directly
+        # callable, so dispatch pays no jit-cache keying — and the same
+        # executable yields cost/memory analytics for free.
+        from ..telemetry import devstats
+        in_specs = tuple(jax.ShapeDtypeStruct(
+            (bucket,) + tuple(self._pred._input_shapes[n][1:]),
+            jnp.float32) for n in self.input_names)
+        state_specs = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                            for s in self._pred._state)
+        rng_spec = jax.ShapeDtypeStruct(self._pred._rng.shape,
+                                        self._pred._rng.dtype)
+        compiled = jax.jit(fn).lower(in_specs, state_specs,
+                                     rng_spec).compile()
+        resident = peak = 0
+        if devstats.enabled():
+            name = "serving.b%d" % bucket
+            stats = devstats.record_program(name, compiled=compiled,
+                                            kind="serving")
+            # resident = what keeping the plan cached pins (the
+            # executable); cpu reports no code size — fall back to the
+            # I/O footprint so the account is never silently zero
+            resident = int(stats["generated_code_bytes"]
+                           or (stats["argument_bytes"]
+                               + stats["output_bytes"]))
+            peak = int(stats["peak_bytes"])
+            # shed the bucket BEFORE admitting it to the cache: a sized
+            # HBMPreflightError beats a runtime OOM mid-request
+            devstats.preflight(name, peak,
+                               resident_bytes=self.plan_resident_bytes,
+                               what="serving bucket plan")
+            devstats.note_compile(name)
+        self._plans[bucket] = compiled
+        self.plan_bytes[bucket] = resident
+        self.plan_peak_bytes[bucket] = peak
+        self.plan_resident_bytes = sum(self.plan_bytes.values())
         self.plan_compiles += 1
-        return plan
+        return compiled
 
     def warmup(self):
         """Compile every bucket plan up front (serving must not pay XLA
@@ -142,10 +191,9 @@ class ServingEngine:
         bucket b compiles; with MXNET_COMPILE_CACHE set, re-runs load
         every bucket plan from the disk cache instead of recompiling.
 
-        The dummies stay host-side numpy on purpose: requests arrive as
-        numpy, and jit's executable fast path keys on input commitment —
-        warming with device-committed arrays would leave the first real
-        request paying a fresh compile."""
+        The dummies stay host-side numpy (the shape requests arrive in);
+        plans are AOT Compiled objects, so input commitment cannot key a
+        fresh compile either way."""
         from ..pipeline import feed_or_inline, close_feed
 
         def _stage(b):
@@ -211,6 +259,11 @@ class ServingEngine:
         return {"buckets": list(self.buckets),
                 "max_batch": self.max_batch,
                 "amp_dtype": self.amp_dtype,
+                "model": self.model_name,
                 "plan_compiles": self.plan_compiles,
+                "plans": len(self._plans),
+                "plan_bytes": dict(self.plan_bytes),
+                "plan_peak_bytes": dict(self.plan_peak_bytes),
+                "plan_resident_bytes": self.plan_resident_bytes,
                 "executions": self.executions,
                 "padded_rows": self.padded_rows}

@@ -17,9 +17,12 @@ percentiles over the window, no unbounded growth under sustained load.
 
 The telemetry registry (mxnet_tpu.telemetry) absorbs the snapshot hook,
 so every field here appears at /metrics as `mxnet_serving_*`; queue
-depth and request latency additionally feed a native registry gauge /
-histogram so Prometheus sees a real cumulative-bucket distribution, not
-just the window percentiles.
+depth, request latency and the engine's compiled-plan cache footprint
+(`mxnet_serving_plan_resident_bytes`, fed by devstats accounting via
+`record_plan_bytes`) additionally feed native registry series so
+Prometheus sees real cumulative-bucket distributions, not just window
+percentiles. When the engine's .mxa manifest names the model, every
+native series carries a `model="<name>"` label.
 """
 from __future__ import annotations
 
@@ -37,11 +40,12 @@ class ServingMetrics:
     _seq = 0
     _seq_lock = threading.Lock()
 
-    def __init__(self, name="serving", latency_window=4096):
+    def __init__(self, name="serving", latency_window=4096, model=None):
         with ServingMetrics._seq_lock:
             ServingMetrics._seq += 1
             seq = ServingMetrics._seq
         self.name = name if seq == 1 else f"{name}#{seq}"
+        self.model = str(model) if model else None
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.requests = 0          # accepted submits
@@ -52,21 +56,31 @@ class ServingMetrics:
         self.batches = 0           # compiled-plan invocations
         self.batched_rows = 0      # rows across all batches
         self.queue_depth = 0       # live queue size (gauge)
+        self.plan_resident_bytes = 0   # engine plan-cache footprint
+        self.plans = 0                 # cached bucket plans
         self._batch_hist = {}      # rows -> count
         self._lat = deque(maxlen=latency_window)
         dom = profiler.Domain(self.name)
         self._c_depth = dom.new_counter("queue_depth")
         self._c_shed = dom.new_counter("shed_total")
         profiler.register_counter_export(self.name, self.snapshot)
-        # native registry series ("#2" -> "_2" for metric-name legality)
+        # native registry series ("#2" -> "_2" for metric-name legality);
+        # model name from the .mxa manifest rides as a constant label so
+        # a multi-model process gets distinguishable series without the
+        # model leaking into metric names
         from ..telemetry import gauge, histogram
         mname = self.name.replace("#", "_")
+        labels = {"model": self.model} if self.model else None
         self._g_depth = gauge(
             f"mxnet_{mname}_queue_depth",
-            help="live dynamic-batcher queue size")
+            help="live dynamic-batcher queue size", labels=labels)
         self._h_lat = histogram(
             f"mxnet_{mname}_request_latency_seconds",
-            help="submit-to-resolve request latency")
+            help="submit-to-resolve request latency", labels=labels)
+        self._g_plan_bytes = gauge(
+            f"mxnet_{mname}_plan_resident_bytes",
+            help="bytes resident in the engine's compiled bucket-plan "
+                 "cache (devstats accounting)", labels=labels)
 
     def close(self):
         profiler.unregister_counter_export(self.name)
@@ -109,6 +123,16 @@ class ServingMetrics:
             self._lat.append(latency_s)
         self._h_lat.observe(latency_s)
 
+    def record_plan_bytes(self, resident_bytes, plans=None):
+        """Engine plan-cache footprint (ServingEngine.plan_resident_bytes,
+        devstats-measured). Called after each bucket admit and on batcher
+        attach, so /metrics carries the live cache size next to QPS/p99."""
+        with self._lock:
+            self.plan_resident_bytes = int(resident_bytes)
+            if plans is not None:
+                self.plans = int(plans)
+        self._g_plan_bytes.set(int(resident_bytes))
+
     # -- reading ------------------------------------------------------------
 
     def _percentile_ms(self, lat_sorted, p):
@@ -140,6 +164,9 @@ class ServingMetrics:
                 "p50_ms": self._percentile_ms(lat, 50),
                 "p99_ms": self._percentile_ms(lat, 99),
                 "uptime_s": round(elapsed, 3),
+                "model": self.model,
+                "plans": self.plans,
+                "plan_resident_bytes": self.plan_resident_bytes,
             }
 
     def to_json(self):

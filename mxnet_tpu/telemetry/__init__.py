@@ -28,6 +28,13 @@ Beyond-reference subsystem (docs/TELEMETRY.md). Four pieces:
     spans/events dumped as a per-rank black box on DistRankFailure,
     watchdog stall, uncaught exception, or SIGTERM; the cluster launcher
     collects the boxes and names the rank that went quiet first.
+  - **device efficiency** (devstats.py): XLA cost/memory analytics from
+    every compile funnel (fused trainers, serving bucket plans, export)
+    as `mxnet_devstats_*` gauges; per-step MFU/roofline attainment in
+    the steplog; an HBM preflight that rejects oversized plans with a
+    sized error before dispatch; and a recompile sentinel
+    (`mxnet_recompiles_total`, flight-recorder storm events).
+    `MXNET_DEVSTATS=0` turns it off (bit-identical either way).
 
 Selftest: `python -m mxnet_tpu.telemetry --selftest` runs a short fit
 with the server up, scrapes itself, asserts every subsystem's counters
@@ -43,6 +50,7 @@ from .steplog import StepLogger, enabled, log_event, maybe_step_logger
 from . import watchdog
 from . import tracing
 from . import flightrec
+from . import devstats
 from .watchdog import install as install_watchdog
 from .tracing import span, traced
 
@@ -50,4 +58,4 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "counter", "gauge",
            "histogram", "get_registry", "TelemetryServer", "start_server",
            "stop_server", "get_server", "StepLogger", "maybe_step_logger",
            "enabled", "log_event", "watchdog", "install_watchdog",
-           "tracing", "flightrec", "span", "traced"]
+           "tracing", "flightrec", "devstats", "span", "traced"]

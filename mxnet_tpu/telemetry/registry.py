@@ -44,14 +44,25 @@ def _sanitize(name):
 
 
 class _Metric:
-    """Shared shell: name, help text, one lock."""
+    """Shared shell: name, help text, one lock. `labels` are constant
+    per-metric labels stamped on every rendered sample (e.g. serving's
+    model="resnet") — identity the metric NAME shouldn't carry."""
 
     kind = "untyped"
 
-    def __init__(self, name, help=""):
+    def __init__(self, name, help="", labels=None):
         self.name = _sanitize(name)
         self.help = help
+        self.labels = {}
+        for k, v in dict(labels or {}).items():
+            v = str(v).replace("\\", "\\\\").replace('"', '\\"')
+            self.labels[_sanitize(str(k))] = v
         self._lock = threading.Lock()
+
+    def _labeled(self, lines):
+        if not self.labels:
+            return lines
+        return [_with_labels(line, self.labels) for line in lines]
 
 
 class Counter(_Metric):
@@ -59,8 +70,8 @@ class Counter(_Metric):
 
     kind = "counter"
 
-    def __init__(self, name, help=""):
-        super().__init__(name, help)
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels=labels)
         self._value = 0
 
     def inc(self, n=1):
@@ -74,7 +85,7 @@ class Counter(_Metric):
             return self._value
 
     def _render(self):
-        return [f"{self.name} {_fmt(self.value())}"]
+        return self._labeled([f"{self.name} {_fmt(self.value())}"])
 
     def _snapshot(self):
         return self.value()
@@ -83,8 +94,8 @@ class Counter(_Metric):
 class Gauge(_Metric):
     kind = "gauge"
 
-    def __init__(self, name, help=""):
-        super().__init__(name, help)
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels=labels)
         self._value = 0.0
 
     def set(self, v):
@@ -104,7 +115,7 @@ class Gauge(_Metric):
             return self._value
 
     def _render(self):
-        return [f"{self.name} {_fmt(self.value())}"]
+        return self._labeled([f"{self.name} {_fmt(self.value())}"])
 
     def _snapshot(self):
         return self.value()
@@ -123,8 +134,8 @@ class Histogram(_Metric):
 
     kind = "histogram"
 
-    def __init__(self, name, help="", buckets=None):
-        super().__init__(name, help)
+    def __init__(self, name, help="", buckets=None, labels=None):
+        super().__init__(name, help, labels=labels)
         bounds = tuple(sorted(float(b) for b in (buckets or
                                                  DEFAULT_BUCKETS)))
         if not bounds:
@@ -179,7 +190,7 @@ class Histogram(_Metric):
         lines.append(f'{self.name}_bucket{{le="+Inf"}} {n}')
         lines.append(f"{self.name}_sum {_fmt(s)}")
         lines.append(f"{self.name}_count {n}")
-        return lines
+        return self._labeled(lines)
 
     def _snapshot(self):
         snap = self.snapshot()
@@ -268,14 +279,15 @@ class Registry:
             self._metrics[name] = m
             return m
 
-    def counter(self, name, help=""):
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name, help="", labels=None):
+        return self._get_or_create(Counter, name, help, labels=labels)
 
-    def gauge(self, name, help=""):
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name, help="", labels=None):
+        return self._get_or_create(Gauge, name, help, labels=labels)
 
-    def histogram(self, name, help="", buckets=None):
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+    def histogram(self, name, help="", buckets=None, labels=None):
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   labels=labels)
 
     def unregister(self, name):
         with self._lock:
@@ -385,13 +397,14 @@ def get_registry():
     return _default
 
 
-def counter(name, help=""):
-    return get_registry().counter(name, help=help)
+def counter(name, help="", labels=None):
+    return get_registry().counter(name, help=help, labels=labels)
 
 
-def gauge(name, help=""):
-    return get_registry().gauge(name, help=help)
+def gauge(name, help="", labels=None):
+    return get_registry().gauge(name, help=help, labels=labels)
 
 
-def histogram(name, help="", buckets=None):
-    return get_registry().histogram(name, help=help, buckets=buckets)
+def histogram(name, help="", buckets=None, labels=None):
+    return get_registry().histogram(name, help=help, buckets=buckets,
+                                    labels=labels)

@@ -33,6 +33,7 @@ import os
 import threading
 import time
 
+from . import devstats as _devstats
 from . import watchdog as _watchdog
 from .registry import counter, gauge, histogram
 
@@ -223,6 +224,14 @@ class StepLogger:
         if loss is not None:
             self._g_loss.set(float(loss))
         trace_fields = self._trace_sample(wall, n)
+        # device-efficiency fields (telemetry/devstats.py): MFU and
+        # roofline attainment from the step program's XLA FLOPs/bytes —
+        # like _trace_sample, gauge updates happen even with no JSONL
+        # sink, and the sample is host floats only (no device sync)
+        try:
+            devstats_fields = _devstats.step_sample(wall, int(steps))
+        except Exception:
+            devstats_fields = None
         if self._file is None:
             return
         amp_scale, amp_skipped = self._amp_sample()
@@ -242,6 +251,8 @@ class StepLogger:
         if trace_fields:
             rec["trace_id"] = self.trace_id
             rec.update(trace_fields)
+        if devstats_fields:
+            rec.update(devstats_fields)
         zero = self._zero_counters()
         if zero is not None:
             last = self._zero_last or {"zero_wire_bytes": 0}

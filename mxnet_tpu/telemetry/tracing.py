@@ -39,6 +39,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import re
 import threading
 import time
 
@@ -370,6 +371,13 @@ def _shard_paths(shards):
     return [str(p) for p in shards]
 
 
+def _rank_from_path(path):
+    """Best-effort rank recovery for a shard whose JSON is unreadable —
+    the trace-rank-K.json naming convention is the only intact bit."""
+    m = re.search(r"trace-rank-(\d+)\.json$", os.path.basename(str(path)))
+    return int(m.group(1)) if m else None
+
+
 def merge(shards, out_path=None):
     """Align per-rank shards into one perfetto-loadable timeline.
 
@@ -379,14 +387,27 @@ def merge(shards, out_path=None):
     earliest event is t=0; every event is re-pid'd to its rank. Returns
     (out_path, summary) where summary carries the critical path: the
     slowest rank per (step, phase), per-phase totals per rank, and the
-    rank that went quiet first."""
+    rank that went quiet first.
+
+    Degrades gracefully when a gang died mid-run: a shard that is
+    missing from the set, unreadable, or torn (truncated JSON from a
+    killed rank) is skipped, the survivors are merged, and the summary
+    records the damage — `torn_shards` (per-path parse errors, rank
+    recovered from the filename) and `missing_ranks` (gaps in the
+    0..max contiguous rank range). Raises FileNotFoundError only when
+    not a single shard is readable."""
     paths = _shard_paths(shards)
     if not paths:
         raise FileNotFoundError(f"no trace shards found in {shards!r}")
-    merged, per_rank = [], {}
+    merged, per_rank, torn = [], {}, []
     for p in paths:
-        with open(p, "r", encoding="utf-8") as f:
-            shard = json.load(f)
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                shard = json.load(f)
+        except (OSError, ValueError) as e:
+            torn.append({"path": p, "rank": _rank_from_path(p),
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
         meta = shard.get("metadata", {})
         r = int(meta.get("rank", 0))
         adj = float(meta.get("clock_offset_us", 0.0)) \
@@ -409,6 +430,10 @@ def merge(shards, out_path=None):
                        "clock_exchanged":
                            bool(meta.get("clock_exchanged", False)),
                        "phase_totals_us": meta.get("phase_totals_us", {})}
+    if not per_rank:
+        raise FileNotFoundError(
+            f"no readable trace shards in {shards!r} "
+            f"({len(torn)} unreadable/torn)")
     t0 = min((ev["ts"] for ev in merged if "ts" in ev), default=0.0)
     for ev in merged:
         if "ts" in ev:
@@ -421,8 +446,17 @@ def merge(shards, out_path=None):
         header.append({"name": "process_sort_index", "ph": "M", "pid": r,
                        "args": {"sort_index": r}})
     summary = _summarize(merged, per_rank, t0)
+    # damage report: ranks whose shard was torn, plus gaps in the
+    # contiguous 0..max rank range with no shard at all
+    known = set(per_rank) | {t["rank"] for t in torn
+                             if t["rank"] is not None}
+    missing = sorted(r for r in range(max(known) + 1 if known else 0)
+                     if r not in per_rank
+                     and all(t["rank"] != r for t in torn))
+    summary["torn_shards"] = torn
+    summary["missing_ranks"] = missing
     out = {"traceEvents": header + merged, "displayTimeUnit": "ms",
-           "metadata": {"merged_from": len(paths), "t0_wall_us": t0,
+           "metadata": {"merged_from": len(per_rank), "t0_wall_us": t0,
                         "ranks": sorted(per_rank)},
            "summary": summary}
     if out_path is None:
@@ -481,6 +515,15 @@ def format_summary(summary):
     lines = [f"merged {summary['events']} events from ranks "
              f"{summary['ranks']} "
              f"({summary['dropped_events']} dropped at source)"]
+    missing = summary.get("missing_ranks")
+    if missing:
+        lines.append(f"MISSING: no shard for ranks {missing} — merged "
+                     f"the survivors")
+    for t in summary.get("torn_shards") or []:
+        who = f"rank {t['rank']}" if t.get("rank") is not None \
+            else os.path.basename(t["path"])
+        lines.append(f"TORN: {who} shard unreadable ({t['error']}) — "
+                     f"skipped")
     q = summary.get("quiet_first")
     if q:
         lines.append(f"quiet first: rank {q['rank']} — last event at "

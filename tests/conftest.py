@@ -79,6 +79,11 @@ _QUICK = {
     "test_tracing.py::test_span_nesting_and_thread_stacks",
     "test_tracing.py::test_event_ring_bound_and_drop_accounting",
     "test_tracing.py::test_merge_aligns_clocks_and_names_victims",
+    "test_tracing.py::test_merge_survives_missing_and_torn_shards",
+    "test_devstats.py::test_preflight_accept_reject_boundaries",
+    "test_devstats.py::test_recompile_sentinel_threshold",
+    "test_devstats.py::test_mfu_and_roofline_arithmetic",
+    "test_devstats.py::test_serving_resident_bytes_accounting_across_admits",
     "test_tracing.py::test_steplog_phase_fields_and_overlap_fracs",
     "test_tracing.py::test_flightrec_ring_dump_and_tail",
     "test_zero.py::test_zero1_fp32_bit_identical",

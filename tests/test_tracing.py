@@ -201,6 +201,41 @@ def test_merge_skew_correction_uses_metadata(tmp_path):
     assert summary["dropped_events"] == 0
 
 
+def test_merge_survives_missing_and_torn_shards(tmp_path):
+    # post-mortem reality: rank 2 died before dumping (no shard), rank 3
+    # was killed mid-write (truncated JSON) — merge the survivors and
+    # say so, instead of raising on the first bad shard
+    d = str(tmp_path / "wreck")
+    tracing.synth_shards(d, ranks=4, steps=3)
+    os.remove(os.path.join(d, "trace-rank-2.json"))
+    p3 = os.path.join(d, "trace-rank-3.json")
+    raw = open(p3, encoding="utf-8").read()
+    open(p3, "w", encoding="utf-8").write(raw[: len(raw) // 2])
+    out, summary = tracing.merge(d)
+    assert summary["ranks"] == [0, 1]
+    assert summary["missing_ranks"] == [2]
+    assert [t["rank"] for t in summary["torn_shards"]] == [3]
+    assert "JSONDecodeError" in summary["torn_shards"][0]["error"]
+    # survivors fully merged (3 phases x 3 steps x 2 ranks)
+    assert summary["events"] == 18
+    m = json.loads(open(out, encoding="utf-8").read())
+    assert sorted({e["pid"] for e in m["traceEvents"]}) == [0, 1]
+    assert m["metadata"]["merged_from"] == 2
+    txt = tracing.format_summary(summary)
+    assert "MISSING" in txt and "[2]" in txt and "TORN" in txt
+    # a clean merge reports no damage
+    d2 = str(tmp_path / "clean")
+    tracing.synth_shards(d2, ranks=2, steps=1)
+    _, clean = tracing.merge(d2)
+    assert clean["missing_ranks"] == [] and clean["torn_shards"] == []
+    # zero readable shards is still an error
+    d3 = str(tmp_path / "allgone")
+    for p in tracing.synth_shards(d3, ranks=2, steps=1):
+        open(p, "w", encoding="utf-8").write("{torn")
+    with pytest.raises(FileNotFoundError):
+        tracing.merge(d3)
+
+
 # -- steplog integration -----------------------------------------------------
 
 def test_steplog_phase_fields_and_overlap_fracs(monkeypatch, tmp_path):

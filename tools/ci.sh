@@ -49,6 +49,9 @@ case "$stage" in
     echo "== tracing smoke (spans/ring/shard merge/flight recorder)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.telemetry.tracing --selftest
+    echo "== devstats smoke (XLA cost/memory, MFU, preflight, sentinel)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.telemetry.devstats --selftest
     echo "== cluster smoke (2-proc gang: barrier, kill injection, resume)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.cluster --selftest --nprocs 2

@@ -10,6 +10,12 @@ wall timebase using the clock offsets/skews recorded in each shard,
 fuses them into one perfetto/chrome-tracing loadable JSON, and prints
 the critical-path summary — slowest rank per phase per step, and which
 rank went quiet first.
+
+Built for post-mortems, so it tolerates a dead gang's debris: a missing
+rank shard or a torn one (truncated JSON from a killed process) is
+skipped, the survivors are merged, and the summary calls out the gap
+(`missing_ranks` / `torn_shards`, MISSING/TORN lines in the printout)
+instead of the merge raising. It fails only when no shard is readable.
 """
 import os
 import sys
