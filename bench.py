@@ -1352,6 +1352,9 @@ def _analysis_lane():
     return {"strict_ok": proc.returncode == 0,
             "wall_s": round(wall_s, 1),
             "counts": rec.get("counts"),
+            # per-pass-family wall time + finding counts, so a pass
+            # whose cost regresses shows up in the bench series
+            "families": rec.get("families"),
             "suppressed": rec.get("suppressed"),
             "strict_failures": rec.get("strict_failures")}
 

@@ -1,6 +1,6 @@
 """mxnet_tpu.analysis — static analysis over the framework itself.
 
-Three pass families, one finding model, one baseline file:
+Six pass families, one finding model, one baseline file:
 
   - ``tracelint``  AST passes that flag trace-impurity hazards inside
     functions traced by jax (host syncs on traced values, wall-clock/RNG
@@ -10,6 +10,18 @@ Three pass families, one finding model, one baseline file:
     between threads (modules declare intentionally lock-free surfaces in
     a small ``__analysis_thread_safe__`` annotation table the pass
     consumes);
+  - ``commlint``   a collective-consistency pass: collectives reachable
+    under rank-dependent control flow where the other arm skips or
+    reorders them (the classic cross-rank deadlock), collectives held
+    under locks or inside except/finally, barrier-name reuse across
+    static call sites;
+  - ``leaklint``   a resource-lifecycle audit: threads neither
+    daemonized nor joined, server/socket/file handles without close,
+    non-idempotent ``atexit``/``signal`` registrations, staging dirs
+    without a sweep;
+  - ``configlint`` config drift: every ``MXNET_*`` env read must be
+    declared in ``config.py`` and documented in ``docs/env_vars.md``
+    (and vice versa), with consistent defaults across read sites;
   - ``hloaudit``   compiles a matrix of representative programs and
     asserts post-SPMD HLO properties (half-width amp collectives, buffer
     donation on the fused step, no f64, convert/recompile budgets).

@@ -1,42 +1,66 @@
 """Analysis CLI — the ci.sh quick gate.
 
-    python -m mxnet_tpu.analysis [--strict] [--json] [--skip-hlo]
-                                 [--baseline PATH] [--write-baseline]
+    python -m mxnet_tpu.analysis [--strict] [--json] [--github]
+                                 [--skip-hlo] [--baseline PATH]
+                                 [--write-baseline]
 
-Runs all three pass families (tracelint + locklint over the package
-source, hloaudit over freshly compiled programs), suppresses findings
-listed in tools/analysis_baseline.json, prints the rest, and — under
-``--strict`` (or MXNET_ANALYSIS_STRICT=1) — exits non-zero if any
-unsuppressed P0/P1 remains. P2s never fail strict; they are burn-down
-material tracked in the baseline.
+Runs all six pass families (tracelint + locklint + commlint + leaklint
++ configlint over the package source, hloaudit over freshly compiled
+programs), suppresses findings listed in tools/analysis_baseline.json,
+prints the rest, and — under ``--strict`` (or MXNET_ANALYSIS_STRICT=1)
+— exits non-zero if any unsuppressed P0/P1 remains. P2s never fail
+strict; they are burn-down material tracked in the baseline.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
 from . import (default_baseline_path, load_baseline, package_root,
                save_baseline, strict_default, strict_failures, suppress)
-from . import locklint, tracelint
+from . import commlint, configlint, leaklint, locklint, tracelint
+
+
+def _github_annotations(active, root):
+    """GitHub Actions workflow commands, one per active finding, so CI
+    renders them inline on the diff. P2s annotate as warnings."""
+    lines = []
+    repo = os.path.dirname(os.path.abspath(root))
+    for f in active:
+        # repo-relative regardless of cwd — GitHub resolves annotation
+        # paths against the checkout root, not the runner's working dir
+        path = os.path.relpath(os.path.join(root, f.file), repo)
+        kind = "warning" if f.severity == "P2" else "error"
+        msg = f"[{f.severity}] {f.rule}: {f.message}".replace(
+            "%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+        lines.append(f"::{kind} file={path},line={f.line}::{msg}")
+    return lines
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.analysis",
-        description="trace-purity lint, concurrency audit and HLO "
-                    "invariant auditor (docs/ANALYSIS.md)")
+        description="trace-purity, concurrency, collective-consistency, "
+                    "resource-lifecycle, config-drift and HLO invariant "
+                    "auditors (docs/ANALYSIS.md)")
     ap.add_argument("--strict", action="store_true", default=None,
                     help="exit non-zero on unsuppressed P0/P1 (default "
                          "when MXNET_ANALYSIS_STRICT=1)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
+    ap.add_argument("--github", action="store_true",
+                    help="also emit ::error/::warning workflow "
+                         "annotations for GitHub Actions logs")
     ap.add_argument("--baseline", default=None,
                     help="baseline path (default MXNET_ANALYSIS_BASELINE "
                          "or tools/analysis_baseline.json)")
     ap.add_argument("--write-baseline", action="store_true",
-                    help="record every current finding key as suppressed "
-                         "and exit 0 (burn-down bookkeeping, not a fix)")
+                    help="record current finding keys as suppressed, "
+                         "print the suppression diff and exit 0; refuses "
+                         "to baseline any P0")
     ap.add_argument("--root", default=None,
                     help="source tree to scan (default: the installed "
                          "mxnet_tpu package)")
@@ -50,31 +74,68 @@ def main(argv=None):
     bpath = args.baseline or default_baseline_path()
     baseline = load_baseline(bpath)
 
-    findings = tracelint.scan_tree(root) + locklint.scan_tree(root)
+    findings = []
+    families = {}
+
+    def _run(name, fn):
+        t0 = time.perf_counter()
+        got = fn()
+        families[name] = {
+            "seconds": round(time.perf_counter() - t0, 4),
+            "findings": len(got),
+        }
+        findings.extend(got)
+
+    _run("tracelint", lambda: tracelint.scan_tree(root))
+    _run("locklint", lambda: locklint.scan_tree(root))
+    _run("commlint", lambda: commlint.scan_tree(root))
+    _run("leaklint", lambda: leaklint.scan_tree(root))
+    _run("configlint", lambda: configlint.scan_tree(root))
     if not args.skip_hlo:
         from . import hloaudit
-        findings += hloaudit.run(baseline)
+        _run("hloaudit", lambda: hloaudit.run(baseline))
     findings.sort(key=lambda f: (f.severity, f.file, f.line, f.rule))
     active, suppressed = suppress(findings, baseline)
     failures = strict_failures(findings, baseline)
 
     if args.write_baseline:
-        keys = sorted({f.key() for f in findings}
-                      | set(baseline.get("suppress") or []))
-        baseline["suppress"] = keys
+        old = set(baseline.get("suppress") or [])
+        new = {f.key() for f in findings}
+        if args.skip_hlo:
+            # an hlo-less run must not drop the hlo families' accepted
+            # keys — it never observed those findings
+            new |= {k for k in old if k.startswith("hlo-")}
+        p0_new = sorted({f.key() for f in findings
+                         if f.severity == "P0" and f.key() not in old})
+        if p0_new:
+            print("analysis: REFUSING to baseline P0 findings — fix "
+                  "them at source:", file=sys.stderr)
+            for k in p0_new:
+                print(f"  + {k}", file=sys.stderr)
+            return 1
+        for k in sorted(new - old):
+            print(f"  + {k}")
+        for k in sorted(old - new):
+            print(f"  - {k}")
+        baseline["suppress"] = sorted(new)
         save_baseline(baseline, bpath)
-        print(f"analysis: baseline now suppresses {len(keys)} finding "
-              f"keys -> {bpath}")
+        print(f"analysis: baseline now suppresses {len(new)} finding "
+              f"keys ({len(new - old)} added, {len(old - new)} removed) "
+              f"-> {bpath}")
         return 0
 
     counts = {"P0": 0, "P1": 0, "P2": 0}
     for f in active:
         counts[f.severity] += 1
+    if args.github:
+        for line in _github_annotations(active, root):
+            print(line, flush=True)
     if args.json:
         print(json.dumps({
             "metric": "analysis",
             "findings": [f.to_dict() for f in active],
             "counts": counts,
+            "families": families,
             "suppressed": len(suppressed),
             "strict": bool(strict),
             "strict_failures": len(failures),
@@ -84,9 +145,11 @@ def main(argv=None):
     else:
         for f in active:
             print(f)
+        fam = ", ".join(f"{k} {v['findings']}/{v['seconds']:.2f}s"
+                        for k, v in families.items())
         print(f"analysis: {len(active)} findings ({counts['P0']} P0, "
               f"{counts['P1']} P1, {counts['P2']} P2), "
-              f"{len(suppressed)} suppressed by {bpath}")
+              f"{len(suppressed)} suppressed by {bpath} [{fam}]")
         if strict and failures:
             print(f"analysis: STRICT FAIL — {len(failures)} unsuppressed "
                   f"P0/P1 (fix them or, for accepted P2-grade debt, "

@@ -1,0 +1,442 @@
+"""commlint — collective-consistency audit over the distributed runtime.
+
+A collective (``dist.barrier``/``allreduce_sum``/``broadcast_from_root``,
+the kvstore push/pull verbs, the cooperative-commit seal barriers) is a
+*rendezvous*: every rank must execute the same collective sequence or the
+gang deadlocks until MXNET_DIST_TIMEOUT_S turns the hang into a
+DistRankFailure. PR 12/13 made that failure observable at runtime; this
+pass makes the classic causes visible at review time:
+
+  - ``comm-divergent-collective`` (P0): a collective statically reachable
+    under rank-dependent control flow (``rank == 0`` guards,
+    ``process_index()``-derived branches) where the other arm skips or
+    reorders the collective sequence — including an early ``return`` in a
+    rank-guarded arm with collectives later in the function, and
+    collectives performed transitively through module-local helpers
+    (resolved to a fixed point).
+  - ``comm-collective-under-lock`` (P1): a collective invoked while a
+    lock/condition is held (``with self._lock: ... dist.barrier(...)``).
+    The rendezvous blocks for up to the dist timeout with the lock held,
+    wedging every other thread that needs it (composes with locklint's
+    acquisition graph: the barrier is an edge to a lock no rank can see).
+  - ``comm-barrier-name-reuse`` (P1): the same constant barrier name at
+    more than one static call site. Barrier ids are one-shot
+    (``dist._barrier_seq`` uniquifies per NAME): two sites sharing a name
+    lets rank A's site-1 wait pair with rank B's site-2 wait — they
+    "pass" mismatched barriers and desynchronize. A bare ``dist.barrier()``
+    counts as the documented default name ``"kvstore"``.
+  - ``comm-collective-in-handler`` (P1): a collective lexically inside an
+    ``except``/``finally`` block. Only ranks that entered the handler
+    rendezvous; the others never arrive.
+
+Rank-dependence is syntactic: calls whose last segment is ``rank``/
+``local_rank``/``process_index``/``get_rank``/``worker_id``, names or
+attributes like ``rank``/``*_rank``/``is_root``/``is_primary``/
+``is_chief``, one level of module-local call resolution (a helper whose
+return expression is rank-dependent, e.g. ``self._writes_here()``), and
+one propagation pass over local assignments. ``process_count``/
+``nranks``-style cardinalities are deliberately NOT rank-dependent.
+
+Escapes: restructure so every rank walks the same collective spine
+(see checkpoint/manager.py's save()), or annotate a reviewed site with
+``# analysis: allow=<rule>``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+from .tracelint import _dotted, _apply_inline_allows, _dedupe
+
+__all__ = ["scan_tree", "scan_modules", "scan_source"]
+
+# primitive collective entry points, by last dotted segment
+_COLLECTIVE_LAST = {
+    "barrier", "allreduce_sum", "broadcast_from_root",
+    "sync_global_devices", "process_allgather", "broadcast_one_to_all",
+    "wait_at_barrier",
+}
+# kvstore verbs are collectives only when the receiver looks like a
+# kvstore (kv.push(...)), not on arbitrary lists/dicts
+_KV_VERBS = {"push", "pull", "row_sparse_pull", "pushpull", "init"}
+
+_RANK_CALLS = {"rank", "local_rank", "process_index", "get_rank",
+               "worker_id"}
+_RANK_NAMES = {"rank", "local_rank", "is_root", "is_primary", "is_chief",
+               "is_coordinator", "is_master", "is_main", "is_leader"}
+_LOCKISH = ("lock", "cond", "mutex", "sem")
+
+_BARRIER_DEFAULT_NAME = "kvstore"
+
+
+def _last(name):
+    return name.split(".")[-1] if name else None
+
+
+def _rankish_name(name):
+    if name is None:
+        return False
+    last = _last(name)
+    return last in _RANK_NAMES or last.endswith("_rank")
+
+
+class _Fn:
+    __slots__ = ("node", "qualname", "cls_name", "performs")
+
+    def __init__(self, node, qualname, cls_name):
+        self.node = node
+        self.qualname = qualname
+        self.cls_name = cls_name
+        self.performs = False   # performs a collective (fixed point)
+
+
+class _Mod:
+    """Per-module model: functions with class context, for resolving
+    Name / self.method calls to module-local definitions."""
+
+    def __init__(self, tree, relpath, source_lines):
+        self.relpath = relpath
+        self.source_lines = source_lines
+        self.tree = tree
+        self.top = {}            # module-level function name -> _Fn
+        self.methods = {}        # (class, method) -> _Fn
+        self.fns = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Fn(node, node.name, None)
+                self.top[node.name] = fn
+                self.fns.append(fn)
+            elif isinstance(node, ast.ClassDef):
+                for st in node.body:
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        fn = _Fn(st, f"{node.name}.{st.name}", node.name)
+                        self.methods[(node.name, st.name)] = fn
+                        self.fns.append(fn)
+
+    def resolve(self, call, cls_name):
+        """Module-local _Fn a call resolves to, else None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.top.get(func.id)
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self" and cls_name is not None:
+            return self.methods.get((cls_name, func.attr))
+        return None
+
+
+def _own_nodes(fn_node):
+    """Walk a function body without descending into nested defs/lambdas."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_collective_call(call, mod, cls_name):
+    """Collective descriptor string for a call, else None. Resolves
+    module-local helpers through the performs fixed point."""
+    name = _dotted(call.func)
+    last = _last(name)
+    if last in _COLLECTIVE_LAST:
+        return name or last
+    if last in _KV_VERBS and isinstance(call.func, ast.Attribute):
+        recv = _dotted(call.func.value)
+        if recv and "kv" in _last(recv).lower():
+            return f"{recv}.{last}"
+    target = mod.resolve(call, cls_name)
+    if target is not None and target.performs:
+        return target.qualname
+    return None
+
+
+def _mark_performers(mod):
+    """Fixed point: a function performs a collective when its body
+    contains a primitive collective call or a call to a module-local
+    performer."""
+    changed = True
+    while changed:
+        changed = False
+        for fn in mod.fns:
+            if fn.performs:
+                continue
+            for node in _own_nodes(fn.node):
+                if isinstance(node, ast.Call) and \
+                        _is_collective_call(node, mod, fn.cls_name):
+                    fn.performs = True
+                    changed = True
+                    break
+
+
+# -- rank-dependence ---------------------------------------------------------
+
+def _returns_rankish(fn):
+    """One-level helper resolution: every value this function returns is
+    scanned; any rank-ish name/call makes calls to it rank-dependent
+    (covers `def _writes_here(self): return self.sharded or
+    self._rank == 0`)."""
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if _expr_rankish(node.value, None, None, set()):
+                return True
+    return False
+
+
+def _expr_rankish(expr, mod, cls_name, tainted):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if _last(name) in _RANK_CALLS:
+                return True
+            if mod is not None:
+                target = mod.resolve(node, cls_name)
+                if target is not None and _returns_rankish(target):
+                    return True
+        elif isinstance(node, ast.Attribute):
+            if _rankish_name(node.attr):
+                return True
+        elif isinstance(node, ast.Name):
+            if _rankish_name(node.id) or node.id in tainted:
+                return True
+    return False
+
+
+def _tainted_names(fn, mod):
+    """Local names assigned from rank-dependent expressions (one
+    propagation pass, matching the chains that occur in practice)."""
+    tainted = set()
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Assign) and \
+                _expr_rankish(node.value, mod, fn.cls_name, tainted):
+            for tgt in node.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+    return tainted
+
+
+# -- per-function walks ------------------------------------------------------
+
+def _arm_collectives(stmts, mod, cls_name, out):
+    """Lexical collective descriptors in a statement list (recursing into
+    nested control flow but not nested defs)."""
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                desc = _is_collective_call(node, mod, cls_name)
+                if desc is not None:
+                    out.append((desc, node.lineno))
+    return out
+
+
+def _arm_returns(stmts):
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Return):
+                return True
+    return False
+
+
+def _check_divergence(mod, fn, findings):
+    tainted = _tainted_names(fn, mod)
+    all_sites = _arm_collectives(
+        fn.node.body if not isinstance(fn.node, ast.Lambda) else [],
+        mod, fn.cls_name, [])
+    for node in _own_nodes(fn.node):
+        if not isinstance(node, ast.If):
+            continue
+        if not _expr_rankish(node.test, mod, fn.cls_name, tainted):
+            continue
+        body_seq = _arm_collectives(node.body, mod, fn.cls_name, [])
+        else_seq = _arm_collectives(node.orelse, mod, fn.cls_name, [])
+        guard = ast.get_source_segment(
+            "\n".join(mod.source_lines), node.test) or "<rank guard>"
+        if [d for d, _ in body_seq] != [d for d, _ in else_seq]:
+            only = body_seq if len(body_seq) >= len(else_seq) else else_seq
+            names = ", ".join(sorted({d for d, _ in only})) or "collective"
+            findings.append(Finding(
+                "comm-divergent-collective", "P0", mod.relpath,
+                node.lineno,
+                f"collective sequence diverges across the rank-dependent "
+                f"branch on `{guard}` ({names} on one arm only) — ranks "
+                f"taking the other arm never rendezvous (cross-rank "
+                f"deadlock)", scope=fn.qualname))
+            continue
+        # equal arm sequences, but an early return in a rank-guarded arm
+        # skips every collective later in the function
+        later = [d for d, ln in all_sites
+                 if ln > max(node.lineno, *(s.lineno for s in node.body))]
+        if later and _arm_returns(node.body) != _arm_returns(node.orelse):
+            findings.append(Finding(
+                "comm-divergent-collective", "P0", mod.relpath,
+                node.lineno,
+                f"rank-dependent branch on `{guard}` returns early while "
+                f"{', '.join(sorted(set(later)))} follows in "
+                f"{fn.qualname} — only some ranks reach the later "
+                f"rendezvous", scope=fn.qualname))
+
+
+def _lockish_ctx(expr):
+    name = _dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _dotted(expr.func)
+    if not name:
+        return False
+    last = _last(name).lower()
+    return any(k in last for k in _LOCKISH)
+
+
+def _check_context(mod, fn, findings):
+    """Single walk tracking held-lock and except/finally context."""
+
+    def visit(node, held, handler):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held or any(_lockish_ctx(i.context_expr)
+                                   for i in node.items)
+            for st in node.body:
+                visit(st, new_held, handler)
+            return
+        if isinstance(node, ast.Try):
+            for st in node.body:
+                visit(st, held, handler)
+            for st in node.orelse:
+                visit(st, held, handler)
+            for h in node.handlers:
+                for st in h.body:
+                    visit(st, held, True)
+            for st in node.finalbody:
+                visit(st, held, True)
+            return
+        if isinstance(node, ast.Call):
+            desc = _is_collective_call(node, mod, fn.cls_name)
+            if desc is not None:
+                if held:
+                    findings.append(Finding(
+                        "comm-collective-under-lock", "P1", mod.relpath,
+                        node.lineno,
+                        f"{desc} invoked while holding a lock — the "
+                        f"rendezvous blocks up to MXNET_DIST_TIMEOUT_S "
+                        f"with the lock held, wedging every thread that "
+                        f"needs it", scope=fn.qualname))
+                if handler:
+                    findings.append(Finding(
+                        "comm-collective-in-handler", "P1", mod.relpath,
+                        node.lineno,
+                        f"{desc} inside an except/finally block — only "
+                        f"ranks that entered the handler rendezvous; the "
+                        f"rest never arrive", scope=fn.qualname))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, handler)
+
+    if not isinstance(fn.node, ast.Lambda):
+        for st in fn.node.body:
+            visit(st, False, False)
+
+
+def _barrier_sites(mod):
+    """(name, line, scope) for every statically-named barrier call."""
+    sites = []
+
+    def walk_fn(fn):
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if _last(name) != "barrier":
+                continue
+            arg = None
+            if node.args:
+                arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        arg = kw.value
+            if arg is None and not node.keywords:
+                sites.append((_BARRIER_DEFAULT_NAME, node.lineno,
+                              fn.qualname))
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                              str):
+                sites.append((arg.value, node.lineno, fn.qualname))
+            # dynamic names (f-strings, variables) carry their own
+            # uniqueness contract — out of scope here
+
+    for fn in mod.fns:
+        walk_fn(fn)
+    return sites
+
+
+# -- entry points ------------------------------------------------------------
+
+def scan_modules(sources):
+    """sources: iterable of (source_text, relpath). Returns findings."""
+    mods = []
+    findings = []
+    for src, rel in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        mods.append(_Mod(tree, rel, src.splitlines()))
+    per_mod = {}
+    barrier_names = {}           # name -> [(relpath, line, scope)]
+    for mod in mods:
+        _mark_performers(mod)
+        mf = per_mod.setdefault(mod.relpath, [])
+        for fn in mod.fns:
+            _check_divergence(mod, fn, mf)
+            _check_context(mod, fn, mf)
+        for name, line, scope in _barrier_sites(mod):
+            barrier_names.setdefault(name, []).append(
+                (mod.relpath, line, scope))
+    for name, sites in sorted(barrier_names.items()):
+        if len(sites) < 2:
+            continue
+        where = ", ".join(f"{r}:{ln}" for r, ln, _ in sites)
+        for rel, line, scope in sites:
+            per_mod.setdefault(rel, []).append(Finding(
+                "comm-barrier-name-reuse", "P1", rel, line,
+                f"barrier name {name!r} used at {len(sites)} static call "
+                f"sites ({where}) — the one-shot per-name seq counter "
+                f"lets ranks pair waits from DIFFERENT sites and "
+                f"desynchronize", scope=scope))
+    out = []
+    lines_of = {m.relpath: m.source_lines for m in mods}
+    for rel, fs in per_mod.items():
+        out.extend(_apply_inline_allows(fs, lines_of.get(rel, [])))
+    return _dedupe(sorted(out, key=lambda f: (f.file, f.line, f.rule)))
+
+
+def scan_source(source, relpath="<source>"):
+    return scan_modules([(source, relpath)])
+
+
+def scan_tree(root):
+    sources = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    sources.append((f.read(), os.path.relpath(path, root)))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return scan_modules(sources)

@@ -1,0 +1,307 @@
+"""configlint — config-drift audit across env reads, config.py and docs.
+
+The contract: every ``MXNET_*`` env var read anywhere in ``mxnet_tpu/``
+is declared in ``config.py``'s ``_DOCUMENTED`` table AND documented in
+``docs/env_vars.md`` — and vice versa (no ghost docs) — with consistent
+defaults across read sites. PRs 10-14 added 20+ vars; nothing audited
+them until now.
+
+  - ``config-ghost-var`` (P1): an ``MXNET_*`` var read in the package
+    (``os.environ.get``/``os.environ[...]``/``os.getenv``/
+    ``config.get``/``config.flag``) but absent from ``_DOCUMENTED`` —
+    ``config.get`` silently returns None for it and ``list_vars()``
+    never shows it.
+  - ``config-ghost-doc`` (P1): drift between the declaration table and
+    the operator docs, in either direction — a declared var no operator
+    can discover, or a documented var the code no longer honors.
+  - ``config-default-skew`` (P1): a read site passing an explicit
+    literal default different from the declared one — two call sites
+    disagree about what "unset" means. Numeric defaults compare by
+    value (``"60"`` == ``60.0``); dynamic (non-literal) defaults are
+    out of scope, and ``environ.get("X") or LITERAL`` counts the
+    literal as the site default.
+
+Declared-but-never-read vars are NOT findings: the MXNet parity surface
+deliberately accepts-and-records knobs whose machinery XLA owns.
+Docs tokens ending in ``_`` (wildcard mentions like ``MXNET_TPU_*``) are
+ignored. Reads are AST call sites, never docstring/comment mentions.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from . import Finding
+from .tracelint import _dotted, _apply_inline_allows, _dedupe
+
+__all__ = ["scan_tree", "scan_sources", "declared_vars", "documented_vars"]
+
+_TOKEN = re.compile(r"MX(?:NET|IO)_[A-Z0-9_]+")
+_PREFIXES = ("MXNET_", "MXIO_")
+
+# a sentinel distinct from None (None is a legitimate declared default)
+_DYNAMIC = object()
+
+
+def declared_vars(config_source):
+    """{name: (default_literal_or_DYNAMIC, lineno)} parsed from the
+    ``_DOCUMENTED = {...}`` dict literal — a pure AST read, no import
+    (importing config would drag in jax side effects)."""
+    out = {}
+    try:
+        tree = ast.parse(config_source)
+    except SyntaxError:
+        return out
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_DOCUMENTED"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                default = v.value if isinstance(v, ast.Constant) \
+                    else _DYNAMIC
+                out[k.value] = (default, k.lineno)
+    return out
+
+
+def documented_vars(docs_text):
+    """{name: first lineno} for every MXNET_* token in the docs, with
+    trailing-underscore wildcard mentions (``MXNET_TPU_*``) dropped."""
+    out = {}
+    for i, line in enumerate(docs_text.splitlines(), 1):
+        for tok in _TOKEN.findall(line):
+            if tok.endswith("_"):
+                continue
+            out.setdefault(tok, i)
+    return out
+
+
+class _Read:
+    __slots__ = ("name", "default", "line", "scope", "via")
+
+    def __init__(self, name, default, line, scope, via):
+        self.name = name
+        self.default = default      # literal, None (absent), or _DYNAMIC
+        self.line = line
+        self.scope = scope
+        self.via = via              # "environ" | "config"
+
+
+def _scopes(tree):
+    spans = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                spans.append((child.lineno,
+                              getattr(child, "end_lineno", child.lineno),
+                              qn))
+                walk(child, qn)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}.{child.name}" if prefix
+                     else child.name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+
+    def scope_of(lineno):
+        best = ""
+        for lo, hi, qn in spans:
+            if lo <= lineno <= hi:
+                best = qn
+        return best
+
+    return scope_of
+
+
+def read_sites(source, relpath):
+    """Every MXNET_* read call in one module (AST-level; docstring and
+    comment mentions never count)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    scope_of = _scopes(tree)
+    # `os.environ.get("X") or LITERAL` is this repo's empty-string-safe
+    # default idiom — the literal IS the site default, not skew
+    or_default = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or):
+            for i, v in enumerate(node.values[:-1]):
+                nxt = node.values[i + 1]
+                if isinstance(nxt, ast.Constant):
+                    or_default[id(v)] = nxt.value
+    reads = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            base = _dotted(node.value)
+            if base and base.endswith("environ") and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str) and \
+                    node.slice.value.startswith(_PREFIXES):
+                reads.append(_Read(node.slice.value,
+                                   or_default.get(id(node)), node.lineno,
+                                   scope_of(node.lineno), "environ"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func) or ""
+        via = None
+        if name.endswith("environ.get") or name == "os.getenv" or \
+                name.endswith(".getenv"):
+            via = "environ"
+        elif name.endswith("config.get") or name == "config.get" or \
+                name.endswith("config.flag") or name == "config.flag":
+            via = "config"
+        elif name in ("get", "flag") and relpath.endswith("config.py"):
+            via = "config"
+        if via is None:
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith(_PREFIXES)):
+            continue
+        default = None
+        if len(node.args) > 1:
+            default = node.args[1].value \
+                if isinstance(node.args[1], ast.Constant) else _DYNAMIC
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = kw.value.value \
+                    if isinstance(kw.value, ast.Constant) else _DYNAMIC
+        if default is None and via == "environ" and \
+                id(node) in or_default:
+            # raw environ bypasses config's declared fallback, so the
+            # or-literal IS the site default; after config.get the same
+            # shape merely post-processes the already-defaulted result
+            default = or_default[id(node)]
+        reads.append(_Read(node.args[0].value, default, node.lineno,
+                           scope_of(node.lineno), via))
+    return reads
+
+
+def _defaults_equal(a, b):
+    if a is None and b is None:
+        return True
+    if a is None or b is None:
+        # environ.get("X") with no default vs a declared non-None
+        # default: the site bypasses config's fallback — still skew,
+        # EXCEPT when the declared default is None too (handled above)
+        return False
+    try:
+        return float(str(a)) == float(str(b))
+    except (TypeError, ValueError):
+        return str(a) == str(b)
+
+
+def scan_sources(sources, declared, documented, config_relpath="config.py",
+                 docs_relpath="docs/env_vars.md", config_lines=None,
+                 docs_known=True):
+    """Core checker over parsed inputs (fixture-friendly).
+
+    sources: [(source_text, relpath)] of the package modules;
+    declared: {name: (default, lineno)}; documented: {name: lineno}.
+    """
+    findings = []
+    per_module = []
+    reads_by_var = {}
+    for src, rel in sources:
+        mf = []
+        for r in read_sites(src, rel):
+            reads_by_var.setdefault(r.name, []).append((r, rel))
+            if r.name not in declared:
+                mf.append(Finding(
+                    "config-ghost-var", "P1", rel, r.line,
+                    f"{r.name} is read here but not declared in "
+                    f"config.py's _DOCUMENTED table — config.get() "
+                    f"silently defaults it to None and list_vars() "
+                    f"never shows it", scope=r.scope))
+                continue
+            decl_default, _decl_line = declared[r.name]
+            if r.default is not _DYNAMIC and decl_default is not _DYNAMIC:
+                explicit = r.default is not None or r.via == "environ"
+                if explicit and not _defaults_equal(r.default,
+                                                    decl_default):
+                    findings_default = (
+                        "<unset>" if r.default is None else
+                        repr(r.default))
+                    mf.append(Finding(
+                        "config-default-skew", "P1", rel, r.line,
+                        f"{r.name} read with default {findings_default} "
+                        f"but declared with default "
+                        f"{decl_default!r} in config.py — call sites "
+                        f"disagree about what unset means",
+                        scope=r.scope))
+        per_module.append((mf, src.splitlines()))
+    for mf, lines in per_module:
+        findings.extend(_apply_inline_allows(mf, lines))
+
+    ghost = []
+    if docs_known:
+        for name, (default, line) in sorted(declared.items()):
+            if name not in documented:
+                ghost.append(Finding(
+                    "config-ghost-doc", "P1", config_relpath, line,
+                    f"{name} is declared in config.py but never "
+                    f"documented in {docs_relpath} — operators cannot "
+                    f"discover it", scope="_DOCUMENTED"))
+        for name, line in sorted(documented.items()):
+            if name not in declared:
+                ghost.append(Finding(
+                    "config-ghost-doc", "P1", docs_relpath, line,
+                    f"{name} is documented in {docs_relpath} but not "
+                    f"declared in config.py — a ghost doc for a knob "
+                    f"the code no longer registers", scope=name))
+    if config_lines is not None:
+        ghost = _apply_inline_allows(
+            [f for f in ghost if f.file == config_relpath], config_lines
+        ) + [f for f in ghost if f.file != config_relpath]
+    findings.extend(ghost)
+    return _dedupe(sorted(findings, key=lambda f: (f.file, f.line,
+                                                   f.rule)))
+
+
+def scan_tree(root, config_path=None, docs_path=None):
+    """Scan a package tree. config.py defaults to <root>/config.py and
+    the docs to <root>/../docs/env_vars.md; when config.py is absent
+    (fixture trees) the pass is inert."""
+    config_path = config_path or os.path.join(root, "config.py")
+    docs_path = docs_path or os.path.join(os.path.dirname(root), "docs",
+                                          "env_vars.md")
+    try:
+        with open(config_path, "r", encoding="utf-8") as f:
+            config_source = f.read()
+    except OSError:
+        return []
+    declared = declared_vars(config_source)
+    docs_known = True
+    documented = {}
+    try:
+        with open(docs_path, "r", encoding="utf-8") as f:
+            documented = documented_vars(f.read())
+    except OSError:
+        docs_known = False
+    sources = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    sources.append((f.read(), os.path.relpath(path, root)))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return scan_sources(
+        sources, declared, documented,
+        config_relpath=os.path.relpath(config_path, root),
+        docs_relpath=os.path.relpath(docs_path, root),
+        config_lines=config_source.splitlines(), docs_known=docs_known)

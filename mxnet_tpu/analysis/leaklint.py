@@ -1,0 +1,473 @@
+"""leaklint — resource-lifecycle audit over the whole tree.
+
+The cluster/tracing/devstats growth spurt added dozens of thread spawns,
+HTTP servers, signal hooks and staging directories; only code review
+watched their lifecycles. This pass checks the four shapes that actually
+leak:
+
+  - ``leak-unjoined-thread`` (P1): a ``threading.Thread`` that is
+    started but neither daemonized (``daemon=True`` at construction, or
+    a ``<t>.daemon = True`` assignment) nor ``join()``-ed anywhere in
+    the module. Such a thread pins interpreter exit and outlives the
+    object that spawned it.
+  - ``leak-unclosed-server`` (P1): an ``HTTPServer``/``socketserver``
+    server, raw ``socket``, ``TemporaryDirectory`` or ``open()`` handle
+    bound outside a ``with`` block with no ``close``/``shutdown``/
+    ``server_close``/``cleanup`` on the same binding in the module —
+    the resource leaks on every exception path.
+  - ``leak-double-atexit`` (P1): ``atexit.register``/``signal.signal``
+    inside a re-callable function with no idempotence guard. A second
+    call stacks handlers — and a signal chain that captures its own
+    hook (``prev = signal.signal(...)`` twice) recurses forever when
+    the signal finally arrives.
+  - ``leak-staging-dir`` (P2): a ``tempfile.mkdtemp`` with no matching
+    ``shutil.rmtree`` sweep in the module. Advisory: selftests leave
+    artifact dirs for inspection deliberately (accepted P2s live in the
+    baseline).
+
+Heuristics honor the repo's idioms: ``join()`` anywhere in the module on
+the same simple binding counts, as does a ``for t in threads: t.join()``
+loop over a list-comprehension binding, a close through a one-level
+alias (``f = self._file; f.close()``), or a close of elements appended
+into a collection that a loop later drains. Registrations at module
+level, under an ``if`` (restore/install-once patterns) or behind an
+early ``if ...: return`` guard are exempt, as is registering a bound
+method of a function-local object (per-object cleanup, e.g.
+callback.py's ``atexit.register(manager.close)``). Reviewed intentional
+sites use ``# analysis: allow=<rule>``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding
+from .tracelint import _dotted, _apply_inline_allows, _dedupe
+
+__all__ = ["scan_tree", "scan_modules", "scan_source"]
+
+_SERVER_TYPES = {"HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                 "ThreadingTCPServer", "UDPServer", "ThreadingUDPServer",
+                 "UnixStreamServer", "socket", "TemporaryDirectory",
+                 "open"}
+_CLOSERS = {"close", "shutdown", "server_close", "cleanup", "stop"}
+_REGISTRARS = {"atexit.register", "signal.signal"}
+
+
+def _last(name):
+    return name.split(".")[-1] if name else None
+
+
+def _binding_of(assign_target):
+    """Simple name a resource is bound to: `t` for ``t = ...``, the attr
+    for ``self._srv = ...``; None for anything fancier."""
+    if isinstance(assign_target, ast.Name):
+        return assign_target.id
+    if isinstance(assign_target, ast.Attribute):
+        return assign_target.attr
+    return None
+
+
+def _recv_name(expr):
+    """Last segment of a call receiver: `_thread` for
+    ``self._thread.join()``."""
+    name = _dotted(expr)
+    return _last(name)
+
+
+class _FnCtx:
+    __slots__ = ("name", "qualname", "node", "locals")
+
+    def __init__(self, name, qualname, node):
+        self.name = name
+        self.qualname = qualname
+        self.node = node
+        self.locals = set()
+
+
+def _iter_functions(tree):
+    """(qualname, node) for every function/method, any nesting depth."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                out.append((qn, child))
+                walk(child, qn)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}.{child.name}" if prefix
+                     else child.name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _make_scope_of(tree):
+    """Precomputed lineno -> innermost enclosing function qualname
+    (functions come in lexical order, parents before children, so the
+    last containing match is the innermost). One tree walk, then O(#fn)
+    per lookup — never walk the tree per finding."""
+    spans = [(node.lineno, getattr(node, "end_lineno", node.lineno), qn)
+             for qn, node in _iter_functions(tree)]
+
+    def scope_of(lineno):
+        best = ""
+        for lo, hi, qn in spans:
+            if lo <= lineno <= hi:
+                best = qn
+        return best
+
+    return scope_of
+
+
+def _module_receivers(tree, attrs):
+    """Names X where ``X.<attr>(...)`` is called anywhere in the module,
+    for attr in `attrs` (receiver = last dotted segment)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in attrs:
+            recv = _recv_name(node.func.value)
+            if recv:
+                names.add(recv)
+    return names
+
+
+def _daemon_assigned(tree):
+    """Names X with ``X.daemon = True`` / ``X.setDaemon(True)``."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "daemon" and \
+                        isinstance(node.value, ast.Constant) and \
+                        node.value.value:
+                    recv = _recv_name(tgt.value)
+                    if recv:
+                        names.add(recv)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "setDaemon" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value:
+            recv = _recv_name(node.func.value)
+            if recv:
+                names.add(recv)
+    return names
+
+
+def _with_context_calls(tree):
+    """id()s of Call nodes used as a with-statement context manager
+    (directly or through the first arg of a wrapper like closing())."""
+    ids = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                ids.add(id(expr))
+                if isinstance(expr, ast.Call):
+                    for a in expr.args:
+                        ids.add(id(a))
+    return ids
+
+
+def _kw_true(call, name):
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) and \
+                kw.value.value:
+            return True
+    return False
+
+
+def _loop_managed(tree, attrs):
+    """Iterable names whose elements get ``<attr>()``-ed in a for loop:
+    ``for t in threads: t.join()`` manages every thread in `threads`,
+    ``for f, close in targets: ... f.close()`` manages `targets`."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        tgts = set()
+        if isinstance(node.target, ast.Name):
+            tgts.add(node.target.id)
+        elif isinstance(node.target, ast.Tuple):
+            tgts |= {e.id for e in node.target.elts
+                     if isinstance(e, ast.Name)}
+        it = _recv_name(node.iter)
+        if not tgts or not it:
+            continue
+        for st in node.body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in attrs and \
+                        _recv_name(n.func.value) in tgts:
+                    names.add(it)
+    return names
+
+
+def _alias_sources(tree):
+    """{alias: {source binding}} for ``f = self._file`` shapes — a close
+    on the alias counts as a close on the source."""
+    alias = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, (ast.Name, ast.Attribute)):
+            src = _recv_name(node.value)
+            if src:
+                alias.setdefault(node.targets[0].id, set()).add(src)
+    return alias
+
+
+def _appended_calls(tree):
+    """{id(call): collection name} for calls constructed inside an
+    ``X.append(...)``/``X.add(...)`` argument — the resource is bound to
+    the collection, and loop-managed closes on X count for it."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "add"):
+            recv = _recv_name(node.func.value)
+            if not recv:
+                continue
+            for a in node.args:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Call):
+                        out[id(n)] = recv
+    return out
+
+
+def _rmtree_roots(tree):
+    """Root names mentioned in any shutil.rmtree(...) argument."""
+    roots = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _last(_dotted(node.func)) == "rmtree":
+            for a in node.args:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name):
+                        roots.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        roots.add(n.attr)
+    return roots
+
+
+# -- thread / server / staging rules -----------------------------------------
+
+def _module_findings(source, relpath):
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    findings = []
+    scope_of = _make_scope_of(tree)
+    joiners = _module_receivers(tree, {"join"}) | \
+        _loop_managed(tree, {"join"})
+    closers = _module_receivers(tree, _CLOSERS) | \
+        _loop_managed(tree, _CLOSERS)
+    daemons = _daemon_assigned(tree)
+    starters = _module_receivers(tree, {"start"}) | \
+        _loop_managed(tree, {"start"})
+    alias = _alias_sources(tree)
+    for s in (joiners, closers):
+        for r in list(s):
+            s |= alias.get(r, set())
+    appended = _appended_calls(tree)
+    with_ids = _with_context_calls(tree)
+    rmtrees = _rmtree_roots(tree)
+    returned = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Return, ast.Raise)) and \
+                getattr(node, "value", None) is not None:
+            for n in ast.walk(node.value):
+                returned.add(id(n))
+
+    ctx = (scope_of, relpath, findings, joiners, closers, daemons,
+           starters, appended, with_ids, rmtrees)
+    seen_assign_values = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            binding = _binding_of(node.targets[0])
+            direct = {id(node.value)}
+            if isinstance(node.value, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp)):
+                direct.add(id(node.value.elt))
+            for call in ast.walk(node.value):
+                if isinstance(call, ast.Call):
+                    seen_assign_values.add(id(call))
+                    _check_creation(call, binding if id(call) in direct
+                                    else None, *ctx)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and id(node) not in \
+                seen_assign_values and id(node) not in returned:
+            _check_creation(node, None, *ctx)
+
+    _check_registrations(tree, relpath, findings)
+    return _apply_inline_allows(_dedupe(findings), source.splitlines())
+
+
+def _check_creation(call, binding, scope_of, relpath, findings, joiners,
+                    closers, daemons, starters, appended, with_ids,
+                    rmtrees):
+    last = _last(_dotted(call.func))
+    if last is None or id(call) in with_ids:
+        return
+    if binding is None:
+        binding = appended.get(id(call))
+    scope = scope_of(call.lineno)
+    if last == "Thread":
+        if _kw_true(call, "daemon"):
+            return
+        if binding is not None and binding in daemons:
+            return
+        started = binding in starters if binding is not None else True
+        if not started:
+            return               # construction only — started elsewhere
+        if binding is not None and binding in joiners:
+            return
+        what = f"thread bound to {binding!r}" if binding else \
+            "anonymous thread"
+        findings.append(Finding(
+            "leak-unjoined-thread", "P1", relpath, call.lineno,
+            f"{what} is started but neither daemonized nor joined in "
+            f"this module — it pins interpreter exit and outlives its "
+            f"owner", scope=scope))
+    elif last in _SERVER_TYPES:
+        name = _dotted(call.func)
+        if last == "open" and name not in ("open", "io.open"):
+            return
+        if binding is None:
+            # unbound server/handle: nothing can ever close it, but an
+            # immediate method call (e.g. socket().getsockname()) in a
+            # return/raise position was filtered by the caller
+            findings.append(Finding(
+                "leak-unclosed-server", "P1", relpath, call.lineno,
+                f"{last}(...) handle is never bound, so it can never be "
+                f"closed — leaks on every path", scope=scope))
+            return
+        if binding in closers:
+            return
+        findings.append(Finding(
+            "leak-unclosed-server", "P1", relpath, call.lineno,
+            f"{last}(...) bound to {binding!r} outside a `with` and "
+            f"never closed/shut down in this module — leaks on "
+            f"exception paths", scope=scope))
+    elif last == "mkdtemp":
+        if binding is not None and binding in rmtrees:
+            return
+        what = f"staging dir {binding!r}" if binding else \
+            "anonymous staging dir"
+        findings.append(Finding(
+            "leak-staging-dir", "P2", relpath, call.lineno,
+            f"{what} from tempfile.mkdtemp has no matching shutil.rmtree "
+            f"sweep in this module (advisory: baseline deliberate "
+            f"selftest artifact dirs)", scope=scope))
+
+
+# -- registration idempotence ------------------------------------------------
+
+def _check_registrations(tree, relpath, findings):
+    for qn, fn_node in _iter_functions(tree):
+        params = {a.arg for a in fn_node.args.args
+                  + fn_node.args.posonlyargs + fn_node.args.kwonlyargs}
+        local = set(params)
+        guarded_ids = set()      # nodes under an If (install-once shape)
+        saw_guard_return = []    # (lineno of an `if ...: return` guard)
+
+        def collect(node, under_if):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn_node:
+                return
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local.add(tgt.id)
+            if isinstance(node, ast.If):
+                if any(isinstance(n, ast.Return)
+                       for st in node.body for n in ast.walk(st)):
+                    saw_guard_return.append(node.lineno)
+                for st in node.body + node.orelse:
+                    collect(st, True)
+                return
+            if under_if:
+                guarded_ids.add(id(node))
+            for child in ast.iter_child_nodes(node):
+                collect(child, under_if)
+
+        for st in fn_node.body:
+            collect(st, False)
+
+        own = []
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            own.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name not in _REGISTRARS:
+                continue
+            if id(node) in guarded_ids:
+                continue         # install-once / restore-previous shape
+            if any(ln < node.lineno for ln in saw_guard_return):
+                continue         # early `if already: return` guard
+            handler = None
+            if name == "atexit.register" and node.args:
+                handler = node.args[0]
+            elif name == "signal.signal" and len(node.args) > 1:
+                handler = node.args[1]
+            root = handler
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in local and \
+                    root.id not in ("self", "cls"):
+                continue         # per-object cleanup of a local resource
+            findings.append(Finding(
+                "leak-double-atexit", "P1", relpath, node.lineno,
+                f"{name}(...) in re-callable {qn}() has no idempotence "
+                f"guard — a second call stacks handlers (a signal chain "
+                f"capturing its own hook recurses forever)", scope=qn))
+
+
+# -- entry points ------------------------------------------------------------
+
+def scan_modules(sources):
+    findings = []
+    for src, rel in sources:
+        findings.extend(_module_findings(src, rel))
+    return findings
+
+
+def scan_source(source, relpath="<source>"):
+    return _module_findings(source, relpath)
+
+
+def scan_tree(root):
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    findings.extend(_module_findings(f.read(),
+                                                     os.path.relpath(
+                                                         path, root)))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return findings

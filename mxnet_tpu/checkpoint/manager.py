@@ -268,15 +268,31 @@ class CheckpointManager:
             blocking = not self._async
         step = int(step)
         state.meta.setdefault("step", step)
-        if self._writes_here():
+        if self._nranks > 1 and self.sharded:
+            # cooperative commit IS a collective: the branch condition is
+            # rank-independent so every rank enters it, and it always runs
+            # blocking on the train thread (async is forced off for
+            # multi-process jobs) — its barriers must stay in collective
+            # order with training, never on a saver thread
+            self.wait()
+            t0 = time.perf_counter()
+            try:
+                self._commit_cooperative(state, step, metric)
+            finally:
+                with self._cond:
+                    self._counters["ckpt_wait_us"] += int(
+                        (time.perf_counter() - t0) * 1e6)
+        elif self._writes_here():
+            # single-writer path: collective-free, safe under the
+            # rank-dependent guard and on the saver thread
             if blocking:
                 # drain any in-flight async commit first: two overlapping
-                # _commit calls (saver thread + this one) race on staging
-                # dirs and retention sweeps
+                # commits (saver thread + this one) race on staging dirs
+                # and retention sweeps
                 self.wait()
                 t0 = time.perf_counter()
                 try:
-                    self._commit(state, step, metric)
+                    self._commit_local(state, step, metric)
                 finally:
                     with self._cond:
                         self._counters["ckpt_wait_us"] += int(
@@ -372,7 +388,11 @@ class CheckpointManager:
         blocking checkpoint and exit. (Deferred-flag design: saving from
         inside a signal handler could observe a cursor/params pair from
         mid-update.) Main-thread only (signal module contract); returns
-        False elsewhere."""
+        False elsewhere. Idempotent: a second install would capture our
+        own hook as `_prev_sigterm`, and _on_sigterm's chain-to-previous
+        would then recurse forever when the signal finally arrived."""
+        if self._prev_sigterm is not None:
+            return True
         try:
             self._prev_sigterm = signal.signal(signal.SIGTERM,
                                                self._on_sigterm)
@@ -446,7 +466,7 @@ class CheckpointManager:
                     return
                 job = self._job
             try:
-                self._commit(*job)
+                self._commit_local(*job)
             except BaseException as e:     # re-raised on the train thread
                 with self._cond:
                     self._err = e
@@ -574,9 +594,9 @@ class CheckpointManager:
         own = zmeta.get("ownership")
         return own if isinstance(own, dict) else None
 
-    def _commit(self, state, step, metric):
-        if self._nranks > 1 and self.sharded:
-            return self._commit_cooperative(state, step, metric)
+    def _commit_local(self, state, step, metric):
+        # single-process / single-writer commit; must stay collective-free
+        # (it runs on the saver thread and under rank-dependent guards)
         t0 = time.perf_counter()
         self._beat(f"checkpoint_saver step {step}")
         final = os.path.join(self.directory, self._step_dirname(step))

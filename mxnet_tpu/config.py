@@ -93,6 +93,17 @@ _DOCUMENTED = {
     "MXNET_CHECKPOINT_SHARDS": 0,
     "MXNET_CHECKPOINT_RETRIES": 2,
     "MXNET_CHECKPOINT_BACKOFF_S": "0.5",
+    # crash/IO fault injection for the durability tests (CI only):
+    # MXNET_CHECKPOINT_INJECT_CRASH=<pre-rename|post-rename>:<step>
+    # os._exit()s mid-commit; MXNET_CHECKPOINT_INJECT_IO_FAIL=<n> makes
+    # the first n shard writes raise OSError (exercises the retry loop)
+    "MXNET_CHECKPOINT_INJECT_CRASH": None,
+    "MXNET_CHECKPOINT_INJECT_IO_FAIL": 0,
+    # gluon model zoo (gluon/model_zoo): MXNET_HOME relocates the
+    # pretrained-weight cache (default ~/.mxnet); MXNET_GLUON_REPO
+    # points model_store downloads at a mirror of the apache repo
+    "MXNET_HOME": None,
+    "MXNET_GLUON_REPO": None,
     # unified telemetry (mxnet_tpu.telemetry, docs/TELEMETRY.md):
     # MXNET_TELEMETRY=0 disables step recording (watchdog beats remain);
     # MXNET_TELEMETRY_PORT=<port> starts the /metrics + /healthz HTTP
@@ -104,6 +115,9 @@ _DOCUMENTED = {
     "MXNET_TELEMETRY": 1,
     "MXNET_TELEMETRY_PORT": None,
     "MXNET_TELEMETRY_LOG": None,
+    # MXNET_TELEMETRY_HTTP_LOG=1 re-enables the BaseHTTPRequestHandler
+    # per-request stderr lines the /metrics exporter silences by default
+    "MXNET_TELEMETRY_HTTP_LOG": None,
     "MXNET_TELEMETRY_STALL_S": None,
     "MXNET_TELEMETRY_STALL_PATH": None,
     # ZeRO-sharded data parallelism (mxnet_tpu.parallel.zero,
@@ -128,6 +142,9 @@ _DOCUMENTED = {
     # default gang size; MXNET_CLUSTER_INJECT=
     # <kill|hang|exit>@<point>[:rank][@<n>] arms the fault-injection
     # plane (selftests/CI only — see the point table in docs/CLUSTER.md)
+    # MXNET_COORDINATOR=<host:port> overrides the jax distributed
+    # coordinator address init_process_group derives from the launcher
+    "MXNET_COORDINATOR": None,
     "MXNET_DIST_TIMEOUT_S": "60",
     "MXNET_DIST_RETRIES": 1,
     "MXNET_CLUSTER_NPROCS": 2,

@@ -841,7 +841,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            self._updater.set_states(open(fname, "rb").read())
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
 
     def borrow_optimizer(self, shared_module):
         """Share optimizer state with another module (BucketingModule)."""

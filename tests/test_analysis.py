@@ -1,20 +1,26 @@
-"""mxnet_tpu.analysis — trace-purity lint, concurrency audit, HLO
-invariant auditor (ISSUE 9).
+"""mxnet_tpu.analysis — trace-purity lint, concurrency audit,
+collective-consistency, resource-lifecycle and config-drift passes, HLO
+invariant auditor (ISSUEs 9 + 15).
 
-Covers all three pass families with positive AND negative fixtures per
-rule, the finding/baseline plumbing, the CLI strict exit codes, plus
-regression tests for the concurrency bugs the audit's own first run
-surfaced (profiler Counter RMW, serving padded_rows accounting,
-checkpoint blocking-save overlap, steplog teardown).
+Covers all six pass families with positive AND negative fixtures per
+rule, the finding/baseline plumbing, the CLI strict exit codes /
+--github annotations / per-family cost report / write-baseline diff +
+P0 refusal, plus regression tests for the bugs the audits' own first
+runs surfaced (profiler Counter RMW, serving padded_rows accounting,
+checkpoint blocking-save overlap and rank-divergent cooperative commit,
+sigterm-hook idempotence, steplog teardown, module optimizer-state
+handle, config/docs ghost vars).
 
-The acceptance fixtures the issue names are here and live:
+The acceptance fixtures the issues name are here and live:
   - an injected `.item()` inside a scanned step fails strict
     (test_tracelint_item_sync_in_scanned_step);
   - an injected unlocked cross-thread write fails strict
     (test_locklint_cross_thread_write_fails_strict);
   - a broken-donation program fails strict
     (test_hloaudit_broken_donation_fails_strict, against HLO text from
-    a REAL compile, not a synthetic string).
+    a REAL compile, not a synthetic string);
+  - a `rank == 0`-guarded dist.barrier fails strict and passes with the
+    guard removed (test_commlint_rank_guarded_barrier_p0).
 """
 import json
 import os
@@ -30,7 +36,8 @@ import pytest
 from mxnet_tpu.analysis import (DEFAULT_HLO_BUDGETS, Finding, hlo_budget,
                                 load_baseline, package_root,
                                 save_baseline, strict_failures, suppress)
-from mxnet_tpu.analysis import hloaudit, locklint, tracelint
+from mxnet_tpu.analysis import (commlint, configlint, hloaudit,
+                                leaklint, locklint, tracelint)
 
 
 def _src(text):
@@ -463,13 +470,17 @@ def test_cli_strict_exit_codes(tmp_path):
 
 
 def test_repo_is_clean_under_strict():
-    # the shipped contract: source passes over the real package find
-    # nothing the shipped baseline does not list — this is the
-    # regression test for every source-level fix this pass surfaced
-    # (serving padded_rows, profiler Counter, checkpoint manager,
-    # steplog): reintroducing any of them refails here
-    findings = tracelint.scan_tree(package_root()) + \
-        locklint.scan_tree(package_root())
+    # the shipped contract: ALL FIVE source pass families over the real
+    # package find nothing the shipped baseline does not list — this is
+    # the regression test for every source-level fix the passes surfaced
+    # (serving padded_rows, profiler Counter, checkpoint manager
+    # divergent cooperative commit + sigterm hook, steplog, module
+    # optimizer-state open, the config.py/env_vars.md declarations):
+    # reintroducing any of them refails here
+    root = package_root()
+    findings = (tracelint.scan_tree(root) + locklint.scan_tree(root) +
+                commlint.scan_tree(root) + leaklint.scan_tree(root) +
+                configlint.scan_tree(root))
     baseline = load_baseline(os.path.join(os.path.dirname(
         package_root()), "tools", "analysis_baseline.json"))
     bad = strict_failures(findings, baseline)
@@ -670,7 +681,7 @@ def test_checkpoint_blocking_save_drains_inflight_async(tmp_path):
     from mxnet_tpu.checkpoint import CheckpointManager, TrainingState
 
     mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
-    inner = mgr._commit
+    inner = mgr._commit_local
     active, overlap = [0], [0]
     gate = threading.Lock()
 
@@ -685,7 +696,7 @@ def test_checkpoint_blocking_save_drains_inflight_async(tmp_path):
             with gate:
                 active[0] -= 1
 
-    mgr._commit = slow_commit
+    mgr._commit_local = slow_commit
     try:
         st = lambda s: TrainingState(
             arrays={"param:w": np.float32([s])}, meta={"step": s})
@@ -719,3 +730,514 @@ def test_steplog_close_is_idempotent_and_race_safe(tmp_path, monkeypatch):
     stop.set()
     t.join()
     slog.step(samples=1)      # after close: no crash, no resurrection
+
+
+# -- commlint: one positive + one negative fixture per rule ------------------
+
+def test_commlint_rank_guarded_barrier_p0():
+    # ACCEPTANCE: a `rank == 0`-guarded dist.barrier is the classic
+    # cross-rank deadlock and fails strict; dropping the guard passes
+    guarded = _src("""
+        from mxnet_tpu import dist
+
+        def sync(step):
+            if dist.rank() == 0:
+                dist.barrier("sync_step")
+    """)
+    fs = commlint.scan_source(guarded, "fixture.py")
+    assert _rules(fs) == ["comm-divergent-collective"]
+    assert fs[0].severity == "P0" and fs[0].scope == "sync"
+    assert strict_failures(fs, {}), "P0 must fail strict"
+
+    unguarded = guarded.replace('    if dist.rank() == 0:\n    ', '    ')
+    fs = commlint.scan_source(unguarded, "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_commlint_divergence_through_helper_chain():
+    # the checkpoint-manager shape: the collective hides two calls deep
+    # behind a rank-dependent guard method — exactly what save() did
+    # before the cooperative-commit restructure
+    fs = commlint.scan_source(_src("""
+        from mxnet_tpu import dist
+
+        class Mgr:
+            def _writes_here(self):
+                return self._rank == 0
+
+            def _commit(self, step):
+                self._seal(step)
+
+            def _seal(self, step):
+                dist.barrier("seal")
+
+            def save(self, step):
+                if self._writes_here():
+                    self._commit(step)
+    """), "fixture.py")
+    assert _rules(fs) == ["comm-divergent-collective"]
+    assert fs[0].severity == "P0" and fs[0].scope == "Mgr.save"
+
+
+def test_commlint_symmetric_branches_are_clean():
+    # both arms rendezvous (order preserved) — no divergence
+    fs = commlint.scan_source(_src("""
+        from mxnet_tpu import dist
+
+        def sync(x):
+            if dist.rank() == 0:
+                dist.allreduce_sum(x)
+            else:
+                dist.allreduce_sum(x)
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_commlint_collective_under_lock():
+    held = _src("""
+        from mxnet_tpu import dist
+
+        class KV:
+            def push(self):
+                with self._lock:
+                    dist.allreduce_sum(self._buf)
+    """)
+    fs = commlint.scan_source(held, "fixture.py")
+    assert _rules(fs) == ["comm-collective-under-lock"]
+    assert fs[0].severity == "P1" and fs[0].scope == "KV.push"
+    assert strict_failures(fs, {}), "P1 must fail strict"
+    # hoisting the collective out of the critical section passes
+    fs = commlint.scan_source(_src("""
+        from mxnet_tpu import dist
+
+        class KV:
+            def push(self):
+                with self._lock:
+                    buf = self._buf
+                dist.allreduce_sum(buf)
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_commlint_barrier_name_reuse_across_sites():
+    # the one-shot seq counter is per name: two static call sites
+    # sharing one name can pair rank A's site-1 with rank B's site-2
+    fs = commlint.scan_modules([(_src("""
+        from mxnet_tpu import dist
+
+        def setup():
+            dist.barrier("phase")
+
+        def teardown():
+            dist.barrier("phase")
+    """), "fixture.py")])
+    assert _rules(fs) == ["comm-barrier-name-reuse"] * 2
+    assert {f.severity for f in fs} == {"P1"}
+    # distinct names (or per-step f-strings, skipped as dynamic): clean
+    fs = commlint.scan_modules([(_src("""
+        from mxnet_tpu import dist
+
+        def setup():
+            dist.barrier("phase_setup")
+
+        def teardown():
+            dist.barrier("phase_teardown")
+    """), "fixture.py")])
+    assert _rules(fs) == []
+
+
+def test_commlint_collective_in_handler():
+    fs = commlint.scan_source(_src("""
+        from mxnet_tpu import dist
+
+        def step():
+            try:
+                work()
+            except RuntimeError:
+                dist.barrier("recover")
+    """), "fixture.py")
+    assert _rules(fs) == ["comm-collective-in-handler"]
+    assert fs[0].severity == "P1"
+    fs = commlint.scan_source(_src("""
+        from mxnet_tpu import dist
+
+        def step():
+            try:
+                work()
+            except RuntimeError:
+                pass
+            dist.barrier("recover")
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+# -- leaklint: one positive + one negative fixture per rule ------------------
+
+def test_leaklint_unjoined_thread():
+    fs = leaklint.scan_source(_src("""
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=work)
+            t.start()
+    """), "fixture.py")
+    assert _rules(fs) == ["leak-unjoined-thread"]
+    assert fs[0].severity == "P1" and fs[0].scope == "spawn"
+    assert strict_failures(fs, {}), "P1 must fail strict"
+    for fix in ("t.join()", "t.daemon = True"):
+        fs = leaklint.scan_source(_src(f"""
+            import threading
+
+            def spawn():
+                t = threading.Thread(target=work)
+                {'t.start()' if 'join' in fix else fix}
+                {fix if 'join' in fix else 't.start()'}
+        """), "fixture.py")
+        assert _rules(fs) == [], fix
+
+
+def test_leaklint_loop_joined_listcomp_threads_are_clean():
+    # telemetry/__main__ idiom: a comprehension binding drained by a
+    # for-loop join counts as managed
+    fs = leaklint.scan_source(_src("""
+        import threading
+
+        def fan_out():
+            threads = [threading.Thread(target=work) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_leaklint_unclosed_server():
+    fs = leaklint.scan_source(_src("""
+        from http.server import HTTPServer
+
+        class Exporter:
+            def start(self):
+                self._srv = HTTPServer(("", 0), None)
+    """), "fixture.py")
+    assert _rules(fs) == ["leak-unclosed-server"]
+    assert fs[0].severity == "P1"
+    fs = leaklint.scan_source(_src("""
+        from http.server import HTTPServer
+
+        class Exporter:
+            def start(self):
+                self._srv = HTTPServer(("", 0), None)
+
+            def stop(self):
+                self._srv.server_close()
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_leaklint_alias_close_counts():
+    # steplog idiom: close through a one-level alias of the binding
+    fs = leaklint.scan_source(_src("""
+        class Log:
+            def open(self, path):
+                self._file = open(path, "a")
+
+            def close(self):
+                f = self._file
+                if f is not None:
+                    f.close()
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_leaklint_double_atexit():
+    fs = leaklint.scan_source(_src("""
+        import atexit
+
+        def install(self):
+            atexit.register(self._flush)
+    """), "fixture.py")
+    assert _rules(fs) == ["leak-double-atexit"]
+    assert fs[0].severity == "P1" and fs[0].scope == "install"
+    assert strict_failures(fs, {}), "P1 must fail strict"
+    # install-once guard (flightrec/tracing idiom): clean
+    fs = leaklint.scan_source(_src("""
+        import atexit
+
+        def install(self):
+            if self._installed:
+                return
+            atexit.register(self._flush)
+    """), "fixture.py")
+    assert _rules(fs) == []
+    # per-object cleanup of a function-local (callback.py idiom): clean
+    fs = leaklint.scan_source(_src("""
+        import atexit
+
+        def hook(manager):
+            atexit.register(manager.close)
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+def test_leaklint_staging_dir_p2():
+    fs = leaklint.scan_source(_src("""
+        import tempfile
+
+        def stage():
+            d = tempfile.mkdtemp(prefix="stage-")
+            return fill(d)
+    """), "fixture.py")
+    assert _rules(fs) == ["leak-staging-dir"]
+    assert fs[0].severity == "P2"
+    assert not strict_failures(fs, {}), "P2s never fail strict"
+    fs = leaklint.scan_source(_src("""
+        import shutil
+        import tempfile
+
+        def stage():
+            d = tempfile.mkdtemp(prefix="stage-")
+            try:
+                return fill(d)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+    """), "fixture.py")
+    assert _rules(fs) == []
+
+
+# -- configlint: one positive + one negative fixture per rule ----------------
+
+def _config_tree(tmp_path, config_src, docs_text, modules):
+    root = tmp_path / "pkg"
+    root.mkdir(parents=True)
+    (root / "config.py").write_text(_src(config_src))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env_vars.md").write_text(_src(docs_text))
+    for name, src in modules.items():
+        (root / name).write_text(_src(src))
+    return str(root)
+
+
+def test_configlint_ghost_var(tmp_path):
+    root = _config_tree(
+        tmp_path,
+        """_DOCUMENTED = {"MXNET_KNOWN": 1}""",
+        """`MXNET_KNOWN` is documented.""",
+        {"mod.py": """
+            import os
+
+            def f():
+                return os.environ.get("MXNET_GHOST")
+        """})
+    fs = configlint.scan_tree(root)
+    assert _rules(fs) == ["config-ghost-var"]
+    assert fs[0].severity == "P1" and fs[0].file == "mod.py"
+    assert strict_failures(fs, {}), "P1 must fail strict"
+    # declaring + documenting it passes
+    root2 = _config_tree(
+        tmp_path / "ok",
+        """_DOCUMENTED = {"MXNET_KNOWN": 1, "MXNET_GHOST": None}""",
+        """`MXNET_KNOWN` and `MXNET_GHOST` are documented.""",
+        {"mod.py": """
+            import os
+
+            def f():
+                return os.environ.get("MXNET_GHOST")
+        """})
+    assert _rules(configlint.scan_tree(root2)) == []
+
+
+def test_configlint_ghost_doc_both_directions(tmp_path):
+    root = _config_tree(
+        tmp_path,
+        """_DOCUMENTED = {"MXNET_DECLARED_ONLY": 1}""",
+        """Only `MXNET_DOC_ONLY` appears here, plus a `MXNET_TPU_*`
+           wildcard that must not count.""",
+        {})
+    fs = configlint.scan_tree(root)
+    assert _rules(fs) == ["config-ghost-doc"] * 2
+    by_file = {f.file: f for f in fs}
+    assert "config.py" in by_file          # declared, never documented
+    assert any(f.endswith("env_vars.md") for f in by_file)   # ghost doc
+    assert strict_failures(fs, {})
+    root2 = _config_tree(
+        tmp_path / "ok",
+        """_DOCUMENTED = {"MXNET_DECLARED_ONLY": 1}""",
+        """`MXNET_DECLARED_ONLY` is documented (and `MXNET_TPU_*`
+           wildcards still don't count).""",
+        {})
+    assert _rules(configlint.scan_tree(root2)) == []
+
+
+def test_configlint_default_skew(tmp_path):
+    root = _config_tree(
+        tmp_path,
+        """_DOCUMENTED = {"MXNET_TIMEOUT_S": "60"}""",
+        """`MXNET_TIMEOUT_S` is documented.""",
+        {"mod.py": """
+            import os
+
+            def f():
+                return float(os.environ.get("MXNET_TIMEOUT_S", "30"))
+        """})
+    fs = configlint.scan_tree(root)
+    assert _rules(fs) == ["config-default-skew"]
+    assert fs[0].severity == "P1" and strict_failures(fs, {})
+    # numerically-equal defaults (and the `or LITERAL` idiom) are clean
+    root2 = _config_tree(
+        tmp_path / "ok",
+        """_DOCUMENTED = {"MXNET_TIMEOUT_S": "60"}""",
+        """`MXNET_TIMEOUT_S` is documented.""",
+        {"mod.py": """
+            import os
+
+            def f():
+                return float(os.environ.get("MXNET_TIMEOUT_S") or 60.0)
+        """})
+    assert _rules(configlint.scan_tree(root2)) == []
+
+
+def test_configlint_missing_config_is_inert(tmp_path):
+    # fixture trees without a config.py (the CLI tests') scan clean
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text("import os\n")
+    assert configlint.scan_tree(str(root)) == []
+
+
+# -- CLI satellites: --github annotations, families, baseline guard ----------
+
+def _bad_tree(tmp_path):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "bad.py").write_text(_src("""
+        from mxnet_tpu import dist
+
+        def sync():
+            if dist.rank() == 0:
+                dist.barrier("sync")
+    """))
+    return root
+
+
+def test_cli_github_annotations(tmp_path):
+    root = _bad_tree(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--skip-hlo",
+         "--github", "--root", str(root),
+         "--baseline", str(tmp_path / "b.json")],
+        capture_output=True, text=True, timeout=120)
+    ann = [ln for ln in proc.stdout.splitlines()
+           if ln.startswith("::error ")]
+    assert ann, proc.stdout
+    assert "file=" in ann[0] and ",line=" in ann[0]
+    assert "comm-divergent-collective" in ann[0]
+
+
+def test_cli_json_reports_per_family_cost(tmp_path):
+    root = _bad_tree(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--skip-hlo",
+         "--json", "--root", str(root),
+         "--baseline", str(tmp_path / "b.json")],
+        capture_output=True, text=True, timeout=120)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert sorted(rec["families"]) == ["commlint", "configlint",
+                                      "leaklint", "locklint",
+                                      "tracelint"]
+    for fam in rec["families"].values():
+        assert fam["seconds"] >= 0 and fam["findings"] >= 0
+    assert rec["families"]["commlint"]["findings"] == 1
+
+
+def test_cli_write_baseline_refuses_p0(tmp_path):
+    # the stale-baseline footgun: a P0 can never be silently suppressed
+    root = _bad_tree(tmp_path)
+    bl = tmp_path / "b.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--skip-hlo",
+         "--write-baseline", "--root", str(root), "--baseline", str(bl)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "REFUSING" in proc.stderr
+    assert "comm-divergent-collective::bad.py::sync" in proc.stderr
+    assert not bl.exists(), "refusal must not write the baseline"
+
+
+def test_cli_write_baseline_prints_suppression_diff(tmp_path):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "bad.py").write_text(_src("""
+        import tempfile
+
+        def stage():
+            d = tempfile.mkdtemp()
+            return d
+    """))
+    bl = tmp_path / "b.json"
+    save_baseline({"suppress": ["leak-staging-dir::gone.py::old"],
+                   "hlo_budgets": {}}, str(bl))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--skip-hlo",
+         "--write-baseline", "--root", str(root), "--baseline", str(bl)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "  + leak-staging-dir::bad.py::stage" in proc.stdout
+    assert "  - leak-staging-dir::gone.py::old" in proc.stdout
+    assert load_baseline(str(bl))["suppress"] == \
+        ["leak-staging-dir::bad.py::stage"]
+
+
+# -- regression tests for the source fixes the first full run forced ---------
+
+def test_checkpoint_save_has_no_statically_divergent_collective():
+    # save() used to reach _commit_cooperative's barriers under the
+    # rank-dependent _writes_here() guard; the restructure keys the
+    # cooperative path off the rank-independent (nranks, sharded) pair
+    import mxnet_tpu.checkpoint.manager as mgr_mod
+    with open(mgr_mod.__file__, "r", encoding="utf-8") as f:
+        src = f.read()
+    fs = commlint.scan_source(src, "checkpoint/manager.py")
+    assert [f for f in fs if f.rule == "comm-divergent-collective"] == []
+
+
+def test_checkpoint_sigterm_hook_is_idempotent(tmp_path):
+    # double install used to capture our own hook as _prev_sigterm, so
+    # the chain-to-previous in _on_sigterm recursed forever on delivery
+    import signal as _signal
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    before = _signal.getsignal(_signal.SIGTERM)
+    try:
+        assert mgr.install_sigterm_hook()
+        assert mgr.install_sigterm_hook()     # second call: no-op
+        assert mgr._prev_sigterm is not mgr._on_sigterm
+        # handler delivery terminates (no self-chain) and arms the flag
+        mgr._on_sigterm(_signal.SIGTERM, None)
+        assert mgr.preempted
+    finally:
+        mgr.remove_sigterm_hook()
+        mgr.close()
+    assert _signal.getsignal(_signal.SIGTERM) is before
+
+
+def test_config_declares_every_audited_env_var():
+    # the ghost vars the first configlint run surfaced stay declared
+    from mxnet_tpu import config
+    for name in ("MXNET_COORDINATOR", "MXNET_TELEMETRY_HTTP_LOG",
+                 "MXNET_CHECKPOINT_INJECT_CRASH",
+                 "MXNET_CHECKPOINT_INJECT_IO_FAIL",
+                 "MXNET_GLUON_REPO", "MXNET_HOME"):
+        assert name in config._DOCUMENTED, name
+    assert config.get("MXNET_CHECKPOINT_INJECT_IO_FAIL") == 0
+
+
+def test_module_optimizer_state_roundtrip_closes_file(tmp_path):
+    # load_optimizer_states used to leak the open() handle
+    import mxnet_tpu.module.module as module_mod
+    with open(module_mod.__file__, "r", encoding="utf-8") as f:
+        src = f.read()
+    fs = leaklint.scan_source(src, "module/module.py")
+    assert [f for f in fs if f.rule == "leak-unclosed-server"] == []

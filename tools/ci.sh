@@ -58,7 +58,7 @@ case "$stage" in
     echo "== zero smoke (ZeRO-1 bitwise parity, fp8 convergence, HLO wire)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.parallel.zero --selftest
-    echo "== static analysis (tracelint/locklint/hloaudit, --strict gate)"
+    echo "== static analysis (tracelint/locklint/commlint/leaklint/configlint/hloaudit, --strict gate)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.analysis --strict ;;
   full)
