@@ -933,6 +933,40 @@ def _zero_lane():
         f"{(proc.stderr or '').strip()[-300:]}")
 
 
+def _dlrm_lane():
+    """Row-sparse embedding exchange A/B (mxnet_tpu.parallel.embedding,
+    ISSUE 16): a DLRM-style step — sharded 65k-row table, deduped
+    touched-row exchange (plus the fp8-wire arm) vs the dense
+    replicated-table all-reduce — on an 8-virtual-device cpu mesh;
+    steps/s plus per-step collective wire bytes read from each arm's
+    post-SPMD HLO dump. Runs `python -m mxnet_tpu.parallel.embedding
+    --bench` in a fresh subprocess: the 8-device backend and the XLA
+    dump flags must be pinned before jax initializes, and this process
+    already consumed both."""
+    import subprocess
+    import sys
+
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.parallel.embedding", "--bench",
+         "--devices", "8", "--steps", "6" if QUICK else "10"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "embed_bench":
+            rec.pop("metric")
+            return rec
+    raise RuntimeError(
+        f"dlrm bench subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or '').strip()[-300:]}")
+
+
 def _dist_recovery_lane():
     """Distributed-runtime recovery (mxnet_tpu.cluster, ISSUE 12): a real
     2-process jax.distributed gang on the Gloo CPU backend — barrier
@@ -1609,6 +1643,15 @@ def main(argv=None):
     except Exception as e:
         zero_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("zero", zero_lane)
+    # DLRM-style sharded embedding: row-sparse deduped exchange (+fp8
+    # wire) vs dense replicated-table all-reduce at 8 devices (ISSUE 16)
+    try:
+        dlrm_lane = _gated("dlrm", 240, _dlrm_lane)
+    except _BudgetExceeded:
+        dlrm_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        dlrm_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("dlrm", dlrm_lane)
     # fault-tolerant checkpointing A/B: none vs sync vs async commit
     # cadence, restore latency, bytes per commit (ISSUE 5)
     try:
@@ -1781,6 +1824,20 @@ def main(argv=None):
         "zero_wire_bytes_per_step_zero2_fp8": zero_lane.get(
             "wire_bytes_per_step_zero2_fp8"),
         "zero_devices": zero_lane.get("devices"),
+        # DLRM sharded embedding (ISSUE 16): deduped row exchange vs
+        # dense table all-reduce at 8 devices (full payload streamed
+        # above as the "dlrm" lane line)
+        "dlrm_sparse_vs_dense_speedup": dlrm_lane.get(
+            "speedup_sparse", dlrm_lane.get("status")),
+        "dlrm_sparse_fp8_vs_dense_speedup": dlrm_lane.get(
+            "speedup_sparse_fp8"),
+        "dlrm_touched_row_frac": dlrm_lane.get("touched_frac"),
+        "dlrm_wire_bytes_per_step_dense": dlrm_lane.get(
+            "wire_bytes_per_step_dense"),
+        "dlrm_wire_bytes_per_step_sparse": dlrm_lane.get(
+            "wire_bytes_per_step_sparse"),
+        "dlrm_wire_bytes_per_step_sparse_fp8": dlrm_lane.get(
+            "wire_bytes_per_step_sparse_fp8"),
         # checkpointing (ISSUE 5): save-every-3-steps overhead vs no-ckpt
         # baseline, sync vs saver-thread async, plus restore latency
         "checkpoint_sync_overhead_pct": ckpt_lane.get(

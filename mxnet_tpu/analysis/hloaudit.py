@@ -39,7 +39,7 @@ from . import Finding, hlo_budget, package_root
 
 __all__ = ["allreduce_counts", "allreduce_pairing_ok", "has_f64",
            "convert_count", "donated_param_indices", "spmd_allreduces",
-           "spmd_collectives", "collective_counts",
+           "spmd_collectives", "collectives_in_text", "collective_counts",
            "collective_pairing_ok", "collective_wire_bytes",
            "async_pair_stats", "async_interleave_ok",
            "wire_bytes", "parse_last_metric", "audit_findings",
@@ -50,7 +50,7 @@ ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8,
             "f8e4m3fn": 1, "f8e5m2": 1}
 
 PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "fit_step_zero",
-            "serving_bucket")
+            "fit_step_embedding", "serving_bucket")
 
 # the cross-device data-movement ops the ZeRO lane audits. "-start"
 # suffixed async forms are matched alongside the synchronous spelling;
@@ -62,6 +62,7 @@ _PROGRAM_FILE = {
     "fit_step_fp32": "parallel/dp.py",
     "fit_step_bf16": "parallel/dp.py",
     "fit_step_zero": "parallel/zero.py",
+    "fit_step_embedding": "parallel/embedding.py",
     "serving_bucket": "serving/engine.py",
 }
 
@@ -163,6 +164,25 @@ def collective_pairing_ok(hlo):
         for kind in COLLECTIVE_KINDS)
 
 
+_COLL_RX = re.compile(
+    r"=\s*(\w+)\[([\d,]*)\][^=\n]*?"
+    rf"({'|'.join(re.escape(k) for k in COLLECTIVE_KINDS)})"
+    r"(?:-start)?\(")
+
+
+def collectives_in_text(hlo):
+    """kind -> [(dtype, "d0,d1,...")] for every collective in ONE module
+    text (a Compiled's as_text()). The in-process twin of
+    spmd_collectives for audits that already hold the optimized module —
+    no dump directory round-trip. Caveat: backend legalization may have
+    re-widened dtypes by this stage (cpu promotes bf16), so use it for
+    shape/count structure, the dump form for wire-dtype questions."""
+    colls = {kind: [] for kind in COLLECTIVE_KINDS}
+    for m in _COLL_RX.finditer(hlo):
+        colls[m.group(3)].append([m.group(1), m.group(2)])
+    return colls
+
+
 def spmd_collectives(dump_dir, module_substr="jit_step"):
     """kind -> [(dtype, "d0,d1,...")] for every collective in the
     post-SPMD dump of modules matching ``module_substr``. Same dump
@@ -172,13 +192,10 @@ def spmd_collectives(dump_dir, module_substr="jit_step"):
     colls = {kind: [] for kind in COLLECTIVE_KINDS}
     pat = os.path.join(dump_dir,
                        f"*{module_substr}*after_spmd-partitioning*")
-    kinds = "|".join(re.escape(k) for k in COLLECTIVE_KINDS)
-    rx = re.compile(r"=\s*(\w+)\[([\d,]*)\][^=\n]*?"
-                    rf"({kinds})(?:-start)?\(")
     for f in sorted(glob.glob(pat)):
         with open(f, encoding="utf-8") as fh:
             text = fh.read()
-        for m in rx.finditer(text):
+        for m in _COLL_RX.finditer(text):
             colls[m.group(3)].append([m.group(1), m.group(2)])
     return colls
 
@@ -375,6 +392,63 @@ def _audit_programs():
         "cost": _cost(compiled_z),
     }
 
+    # fit_step_embedding: the row-sparse embedding exchange. Compile the
+    # SAME step at two vocab sizes (touched rows held fixed) plus the
+    # dense baseline, and take collective wire bytes straight from the
+    # optimized modules: the exchange payload must not move when only
+    # the vocab grows, and must undercut the dense all-reduce.
+    from mxnet_tpu.parallel.embedding import EmbeddingTrainer
+
+    def _embed_compile(vocab, exchange):
+        tr = EmbeddingTrainer(mesh, vocab=vocab, embed_dim=16, n_slots=2,
+                              mlp_hidden=(32,), optimizer="sgd",
+                              learning_rate=0.1, exchange=exchange,
+                              compress="none", batch_size=16,
+                              rescale_grad=1.0 / 16)
+        state = tr.init_state(16)
+        rng = np.random.RandomState(0)
+        inp = tr.shard_inputs([rng.randint(0, vocab, (16, 2)),
+                               np.zeros((16, 0), np.float32),
+                               rng.randint(0, 2, (16,)).astype(
+                                   np.float32)])
+        tr._ensure_layout(16 // 2 * 2)
+        tr._build_step()
+        compiled = tr._step_fn.lower(*state, *inp).compile()
+        return tr, state, inp, compiled
+
+    tre, state_e, inp_e, compiled_e = _embed_compile(256, "sparse")
+    hlo = compiled_e.as_text()
+    wire_sp = sum(collective_wire_bytes(
+        collectives_in_text(hlo), 2).values())
+    _, _, _, c_big = _embed_compile(1024, "sparse")
+    wire_sp_big = sum(collective_wire_bytes(
+        collectives_in_text(c_big.as_text()), 2).values())
+    _, _, _, c_dn = _embed_compile(256, "dense")
+    wire_dn = sum(collective_wire_bytes(
+        collectives_in_text(c_dn.as_text()), 2).values())
+    cc = collective_counts(hlo)
+    donated = donated_param_indices(hlo)
+    n_leaves = len(jax.tree_util.tree_leaves(state_e))
+    # recompile check: two same-shape dispatches, ONE executable
+    s2, _, _ = tre.step(state_e, inp_e)
+    tre.step(s2, inp_e)
+    out["programs"]["fit_step_embedding"] = {
+        "allreduce_sync": cc["all-reduce"][0],
+        "allreduce_async": cc["all-reduce"][1],
+        "all_gather": sum(cc["all-gather"]),
+        "reduce_scatter": sum(cc["reduce-scatter"]),
+        "wire_bytes_sparse": wire_sp,
+        "wire_bytes_sparse_big_vocab": wire_sp_big,
+        "wire_bytes_dense": wire_dn,
+        "pairing_ok": collective_pairing_ok(hlo),
+        "has_f64": has_f64(hlo),
+        "convert_count": convert_count(hlo),
+        "donated": sorted(donated),
+        "donate_expected": n_leaves,
+        "recompiles": int(tre._step_fn._cache_size()),
+        "cost": _cost(compiled_e),
+    }
+
     sym = _mlp_sym()
     mod = mx.mod.Module(sym, context=mx.cpu(0))
     mod.bind(data_shapes=[("data", (8, 8))],
@@ -476,6 +550,33 @@ def findings_from_report(rec, baseline=None):
                     f"{prog}: {stats['pairs']} async collective pairs, "
                     f"none bracketing compute — bucketed comm/compute "
                     f"overlap is not being scheduled", scope=prog))
+        if prog == "fit_step_embedding":
+            # the row-sparse exchange invariants: wire bytes track
+            # touched rows (identical batch at 4x the vocab must move
+            # identical bytes), and the sparse program must beat the
+            # dense table-sized all-reduce it replaces
+            w1 = r.get("wire_bytes_sparse")
+            w2 = r.get("wire_bytes_sparse_big_vocab")
+            wd = r.get("wire_bytes_dense")
+            if not r.get("all_gather"):
+                findings.append(Finding(
+                    "hlo-embed-missing-allgather", "P0", file, 0,
+                    f"{prog}: no all-gather in the compiled sparse "
+                    f"exchange step — the row exchange is not happening",
+                    scope=prog))
+            if w1 is not None and w2 is not None and w2 != w1:
+                findings.append(Finding(
+                    "hlo-embed-wire-scales-with-vocab", "P1", file, 0,
+                    f"{prog}: sparse exchange moved {w1} wire bytes at "
+                    f"vocab 256 but {w2} at vocab 1024 with the same "
+                    f"batch — payload must scale with touched rows, "
+                    f"not the table", scope=prog))
+            if w1 is not None and wd is not None and w1 >= wd:
+                findings.append(Finding(
+                    "hlo-embed-sparse-not-smaller", "P1", file, 0,
+                    f"{prog}: sparse exchange moves {w1} wire bytes "
+                    f"vs the dense baseline's {wd} — the row-sparse "
+                    f"path lost its reason to exist", scope=prog))
         if not r["pairing_ok"]:
             findings.append(Finding(
                 "hlo-allreduce-pairing", "P0", file, 0,

@@ -586,13 +586,19 @@ class CheckpointManager:
 
     @staticmethod
     def _zero_ownership(state):
-        """The ZeRO trainer's {array name: owning dp rank} map, when the
-        snapshot carries one — shard placement then mirrors which rank
-        already holds the live optimizer shard."""
+        """A trainer's {array name: owning rank} map, when the snapshot
+        carries one — shard placement then mirrors which rank already
+        holds the live array. Two producers: the ZeRO trainer (optimizer
+        shards, meta.trainer.zero) and the sharded-embedding trainer
+        (table + slot rows, meta.trainer.embed); when both appear the
+        maps merge, with per-array names keeping them disjoint."""
         tmeta = state.meta.get("trainer") or {}
-        zmeta = tmeta.get("zero") or {}
-        own = zmeta.get("ownership")
-        return own if isinstance(own, dict) else None
+        merged = {}
+        for sub in ("zero", "embed"):
+            own = (tmeta.get(sub) or {}).get("ownership")
+            if isinstance(own, dict):
+                merged.update(own)
+        return merged or None
 
     def _commit_local(self, state, step, metric):
         # single-process / single-writer commit; must stay collective-free

@@ -131,6 +131,17 @@ _DOCUMENTED = {
     "MXNET_ZERO_STAGE": 0,
     "MXNET_ZERO_BUCKET_MB": "4",
     "MXNET_GRAD_COMPRESS": "none",
+    # sharded-embedding row-sparse exchange (mxnet_tpu.parallel.
+    # embedding, docs/SPARSE.md): MXNET_EMBED_EXCHANGE picks how
+    # embedding gradients cross the wire (sparse = deduped touched rows,
+    # dense = table-sized all-reduce baseline); MXNET_EMBED_UNIQUE_CAP
+    # bounds the static unique-row slot count per device (0 = auto =
+    # the per-device id count, lossless); MXNET_EMBED_COMPRESS casts the
+    # exchanged row values to a narrow wire dtype (fp8 adds per-row
+    # max-abs scales; no error-feedback residual — see docs/SPARSE.md)
+    "MXNET_EMBED_EXCHANGE": "sparse",
+    "MXNET_EMBED_UNIQUE_CAP": "0",
+    "MXNET_EMBED_COMPRESS": "none",
     # multi-process cluster harness + distributed-runtime hardening
     # (mxnet_tpu.cluster + dist.py, docs/CLUSTER.md):
     # MXNET_DIST_TIMEOUT_S (float-string seconds) bounds every

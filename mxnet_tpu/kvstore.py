@@ -117,20 +117,45 @@ class KVStore:
             acc += v.as_in_context(base.context)
         return acc
 
+    def _reduce_row_sparse(self, k, vlist):
+        """Row-sparse reduce (comm.h Reduce over kRowSparseStorage):
+        concatenate every device's (rows, vals), dedup, and SUM duplicate
+        rows — the merged grad keeps row_sparse components so the updater
+        engages the optimizers' scatter fast path (work scales with
+        touched rows, not the table). 2-bit compression is skipped here:
+        the row_sparse wire format is already nnz-scaled, and the
+        error-feedback residual has no stable coordinates on a row set
+        that changes every push (same rationale as the embedding
+        exchange's compression-without-residual, parallel/embedding.py)."""
+        from .ndarray import sparse as _sp
+        stored = self._store[k]
+        return _sp.merge_row_sparse(vlist, shape=stored.shape,
+                                    ctx=vlist[0].context)
+
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray
         keys, vlists = self._key_list(key, value)
         for k, vlist in zip(keys, vlists):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
-            merged = self._reduce(vlist)
-            if self._compression is not None:
-                merged = self._compress(k, merged)
+            row_sparse = all(isinstance(v, RowSparseNDArray)
+                             for v in vlist)
+            if row_sparse:
+                merged = self._reduce_row_sparse(k, vlist)
+            else:
+                merged = self._reduce(vlist)
+                if self._compression is not None:
+                    merged = self._compress(k, merged)
             if self._dist:
                 # cross-process sum: sync parameter-server aggregation
                 # (kvstore_dist_server.h ApplyUpdates :282) as a collective.
                 # With amp on, gradients cross the wire in bf16 and the
                 # sum accumulates in fp32 (half the push bytes; the
-                # updater's master state stays full precision)
+                # updater's master state stays full precision).
+                # row_sparse pushes degrade to their dense backing here —
+                # correct, just not wire-sparse (the allreduce has no
+                # variable-nnz path); the single-process sparse fast path
+                # above is unaffected
                 from . import amp as _amp
                 from . import dist
                 from .ndarray.ndarray import array as nd_array
@@ -214,8 +239,21 @@ class KVStore:
                     f"but {len(rid_list)} row_ids")
             for o, rid in zip(olist, rid_list):
                 ids = rid.asnumpy().astype(_np.int64).ravel()
+                if ids.size and (int(ids.min()) < 0
+                                 or int(ids.max()) >= stored.shape[0]):
+                    # validate BEFORE indexing: a negative id would
+                    # silently wrap to a row from the other end
+                    raise MXNetError(
+                        f"row_sparse_pull: row id out of range "
+                        f"[0, {stored.shape[0]}) for key {k!r} (min "
+                        f"{int(ids.min())}, max {int(ids.max())})")
+                # dedup: masking is idempotent, but downstream consumers
+                # (row_sparse format invariant) assume unique rows — and
+                # an empty id list legitimately pulls all-zeros
+                ids = _np.unique(ids)
                 masked = _np.zeros_like(stored)
-                masked[ids] = stored[ids]
+                if ids.size:
+                    masked[ids] = stored[ids]
                 nd_array(masked, ctx=o.context).copyto(o)
 
     # -- optimizer ----------------------------------------------------------

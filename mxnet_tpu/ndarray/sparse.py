@@ -194,6 +194,59 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     return RowSparseNDArray(nd._data)
 
 
+def merge_row_sparse(parts, shape=None, ctx=None, dtype=None):
+    """Sum row_sparse values (RowSparseNDArray or raw (data, indices)
+    pairs) into ONE canonical RowSparseNDArray: indices from every part
+    are concatenated, deduplicated, and duplicate rows' values SUMMED
+    (np.add.at — the host mirror of ops/sparse_ops.segment_sum_rows).
+    This is the reduce step of a row-sparse gradient push (reference
+    comm.h Reduce over kRowSparseStorage): the result satisfies the
+    unique-row invariant row_sparse_array enforces, so it feeds the
+    optimizers' scatter fast path directly."""
+    datas, idxs = [], []
+    for p in parts:
+        if isinstance(p, RowSparseNDArray):
+            if shape is None:
+                shape = p.shape
+            d = p.data.asnumpy()
+            i = p.indices.asnumpy().astype(_np.int64).ravel()
+        elif isinstance(p, tuple) and len(p) == 2:
+            d, i = p
+            d = _np.asarray(getattr(d, "asnumpy", lambda: d)())
+            i = _np.asarray(getattr(i, "asnumpy", lambda: i)(),
+                            dtype=_np.int64).ravel()
+        else:
+            raise MXNetError(
+                "merge_row_sparse: parts must be RowSparseNDArray or "
+                f"(data, indices) pairs, got {type(p).__name__}")
+        if d.shape[:1] != i.shape:
+            raise MXNetError(
+                f"merge_row_sparse: {len(i)} indices for "
+                f"{d.shape[0] if d.ndim else 0} value rows")
+        datas.append(d)
+        idxs.append(i)
+    if shape is None:
+        raise MXNetError("merge_row_sparse: shape= required when no part "
+                         "is an NDArray")
+    all_idx = (_np.concatenate(idxs) if idxs
+               else _np.zeros(0, _np.int64))
+    if all_idx.size == 0:
+        empty = _np.zeros((0,) + tuple(shape[1:]),
+                          _np.float32 if dtype is None else dtype)
+        return row_sparse_array((empty, all_idx), shape=shape, ctx=ctx,
+                                dtype=dtype)
+    if int(all_idx.min()) < 0 or int(all_idx.max()) >= shape[0]:
+        raise MXNetError(
+            f"merge_row_sparse: row index out of range [0, {shape[0]}) "
+            f"(min {int(all_idx.min())}, max {int(all_idx.max())})")
+    all_dat = _np.concatenate(datas)
+    uniq, inv = _np.unique(all_idx, return_inverse=True)
+    summed = _np.zeros((len(uniq),) + all_dat.shape[1:], all_dat.dtype)
+    _np.add.at(summed, inv, all_dat)
+    return row_sparse_array((summed, uniq), shape=shape, ctx=ctx,
+                            dtype=dtype)
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """sparse.dot — gather-kernel path for dot(csr, dense) and
     dot(csr.T, dense) when the csr carries ELL components (construction

@@ -82,32 +82,74 @@ def ell_dot_t(val, idx, dense, num_features):
     return out.at[idx.reshape(-1)].add(contrib.reshape(r * k, m))
 
 
+def unique_rows(ids, size, fill):
+    """jit-safe static-shape dedup of a flat int row-id vector:
+    ``(uniq (size,), inv (len(ids),), count)`` where ``uniq`` is sorted,
+    padded with ``fill`` (pick one past the valid row range — a value
+    that can never collide with a real id), ``inv`` maps each input
+    position to its slot in ``uniq``, and ``count`` is the number of
+    live (non-fill) uniques. The building block of the row-sparse
+    gradient exchange: dedup happens BEFORE any wire movement, so
+    per-step collective payloads scale with touched rows."""
+    ids = jnp.asarray(ids).reshape(-1).astype(jnp.int32)
+    uniq, inv = jnp.unique(ids, size=size, fill_value=fill,
+                           return_inverse=True)
+    count = jnp.sum(uniq != fill).astype(jnp.int32)
+    return uniq, inv.reshape(-1).astype(jnp.int32), count
+
+
+def segment_sum_rows(vals, inv, num_segments):
+    """Sum value rows that dedup'd to the same unique slot:
+    ``out[inv[i]] += vals[i]`` via a single XLA scatter-add (the vector
+    form of np.add.at). Pair of unique_rows: (uniq, segment_sum) turns
+    per-occurrence gradients into canonical row_sparse (rows, vals)."""
+    vals = jnp.asarray(vals)
+    out = jnp.zeros((num_segments,) + vals.shape[1:], vals.dtype)
+    return out.at[jnp.asarray(inv).reshape(-1)].add(vals)
+
+
+# The rows_* kernels gather with mode="clip" and scatter with
+# mode="drop": an out-of-range row index (>= weight rows) reads row 0's
+# values during the update math (harmless — the result is discarded)
+# and its write is dropped entirely. This is what lets the sharded
+# embedding exchange hand every device the full deduped global row list
+# and mask non-owned/padding slots by mapping them to one-past-the-shard
+# instead of compacting to a dynamic shape XLA can't compile. In-bounds
+# behavior is unchanged (the modes only bind out of range). Negative
+# indices must not be used for masking — they wrap before the mode
+# applies.
+
 def rows_sgd_update(weight, rows, grad_rows, lr, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
     """Row-sparse SGD: touch ONLY the listed rows (reference lazy_update
     sparse kernel semantics — untouched rows skip weight decay too).
-    `rows` must be unique, the row_sparse format invariant (the
-    reference's kernels iterate indices assuming the same)."""
-    g = grad_rows.astype(jnp.float32) * rescale_grad
+    `rows` must be unique among in-bounds entries, the row_sparse format
+    invariant (the reference's kernels iterate indices assuming the
+    same); out-of-bounds entries are dropped."""
+    weight = jnp.asarray(weight)
+    g = jnp.asarray(grad_rows).astype(jnp.float32) * rescale_grad
     if clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
+    w_rows = jnp.take(weight, rows, axis=0, mode="clip")\
+        .astype(jnp.float32)
     upd = -lr * (g + wd * w_rows)
-    return weight.at[rows].add(upd.astype(weight.dtype))
+    return weight.at[rows].add(upd.astype(weight.dtype), mode="drop")
 
 
 def rows_sgd_mom_update(weight, mom, rows, grad_rows, lr, momentum,
                         wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """Row-sparse SGD+momentum: momentum decays ONLY on touched rows
     (reference sgd_mom sparse kernel)."""
-    g = grad_rows.astype(jnp.float32) * rescale_grad
+    weight, mom = jnp.asarray(weight), jnp.asarray(mom)
+    g = jnp.asarray(grad_rows).astype(jnp.float32) * rescale_grad
     if clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
-    m_rows = jnp.take(mom, rows, axis=0).astype(jnp.float32)
+    w_rows = jnp.take(weight, rows, axis=0, mode="clip")\
+        .astype(jnp.float32)
+    m_rows = jnp.take(mom, rows, axis=0, mode="clip").astype(jnp.float32)
     m_new = momentum * m_rows - lr * (g + wd * w_rows)
-    return (weight.at[rows].add(m_new.astype(weight.dtype)),
-            mom.at[rows].set(m_new.astype(mom.dtype)))
+    return (weight.at[rows].add(m_new.astype(weight.dtype), mode="drop"),
+            mom.at[rows].set(m_new.astype(mom.dtype), mode="drop"))
 
 
 def rows_adam_update(weight, mean, var, rows, grad_rows, lr, beta1, beta2,
@@ -117,15 +159,19 @@ def rows_adam_update(weight, mean, var, rows, grad_rows, lr, beta1, beta2,
     prep order: rescale -> +wd*w -> clip (ops/optimizer_ops.py
     _prep_wd_first — decay folds into the grad BEFORE clipping, unlike
     the SGD family)."""
-    w_rows = jnp.take(weight, rows, axis=0).astype(jnp.float32)
-    g = grad_rows.astype(jnp.float32) * rescale_grad + wd * w_rows
+    weight = jnp.asarray(weight)
+    mean, var = jnp.asarray(mean), jnp.asarray(var)
+    w_rows = jnp.take(weight, rows, axis=0, mode="clip")\
+        .astype(jnp.float32)
+    g = jnp.asarray(grad_rows).astype(jnp.float32) * rescale_grad \
+        + wd * w_rows
     if clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    m_rows = jnp.take(mean, rows, axis=0).astype(jnp.float32)
-    v_rows = jnp.take(var, rows, axis=0).astype(jnp.float32)
+    m_rows = jnp.take(mean, rows, axis=0, mode="clip").astype(jnp.float32)
+    v_rows = jnp.take(var, rows, axis=0, mode="clip").astype(jnp.float32)
     m_new = beta1 * m_rows + (1 - beta1) * g
     v_new = beta2 * v_rows + (1 - beta2) * g * g
     step = -lr * m_new / (jnp.sqrt(v_new) + epsilon)
-    return (weight.at[rows].add(step.astype(weight.dtype)),
-            mean.at[rows].set(m_new.astype(mean.dtype)),
-            var.at[rows].set(v_new.astype(var.dtype)))
+    return (weight.at[rows].add(step.astype(weight.dtype), mode="drop"),
+            mean.at[rows].set(m_new.astype(mean.dtype), mode="drop"),
+            var.at[rows].set(v_new.astype(var.dtype), mode="drop"))

@@ -195,7 +195,9 @@ def _sparse_components(grad):
     the scatter-based lazy-update fast path: with true components the
     update touches only nnz rows instead of masking the full table."""
     ell = getattr(grad, "_ell", None)
-    if ell is None:
+    if ell is None or len(ell) != 2:
+        # CSR arrays carry a 3-tuple (val, idx, counts); only the
+        # row_sparse (vals, rows) pair feeds the scatter kernels
         return None
     vals, rows = ell
     return vals, rows

@@ -16,6 +16,8 @@ from .mesh import (build_mesh, data_parallel_mesh, mesh_for_contexts,
 from .dp import DataParallelTrainer
 from . import zero
 from .zero import ZeroTrainer
+from . import embedding
+from .embedding import EmbeddingTrainer
 from . import sp
 from . import tp
 from . import pp
@@ -24,7 +26,7 @@ from .tp import megatron_mlp, moe_ffn
 from .pp import pipeline_mlp
 
 __all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer",
-           "ZeroTrainer", "zero",
+           "ZeroTrainer", "zero", "EmbeddingTrainer", "embedding",
            "mesh_for_contexts", "mesh_for_devices", "replicated_sharding",
            "batch_sharding", "put_replicated", "put_batch_sharded",
            "sp", "tp", "pp", "ring_attention", "ulysses_attention",

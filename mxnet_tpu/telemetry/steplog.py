@@ -108,6 +108,7 @@ class StepLogger:
         # subsystem counter baselines for per-step deltas
         self._ckpt_last = self._ckpt_counters()
         self._zero_last = self._zero_counters()
+        self._embed_last = self._embed_counters()
         # run-scoped trace id: spans closing during this run carry it
         # (tracing.set_step), so JSONL rows and timeline spans correlate
         self.trace_id = "%012x" % int.from_bytes(os.urandom(6), "big")
@@ -149,6 +150,20 @@ class StepLogger:
                 "zero_overlap_frac": c.get("zero_overlap_frac")}
 
     @staticmethod
+    def _embed_counters():
+        """Sharded-embedding exchange counters (parallel.embedding
+        registers its hook once an EmbeddingTrainer exists; None until
+        then keeps the JSONL free of dead embed_* keys). Scraping
+        materializes the trainer's deferred nnz scalar — acceptable at
+        log cadence, never on the step path."""
+        from .. import profiler
+        c = profiler.export_counter("embed")
+        if not isinstance(c, dict):
+            return None
+        return {"embed_wire_bytes": int(c.get("embed_wire_bytes", 0)),
+                "embed_touched_frac": c.get("embed_touched_frac")}
+
+    @staticmethod
     def _amp_sample():
         from .. import amp
         if not amp.is_enabled():
@@ -180,8 +195,13 @@ class StepLogger:
         if not tr.enabled():
             return None
         totals = tr.phase_totals()
-        last = self._trace_last or {}
-        self._trace_last = totals
+        # the baseline swap rides self._lock: step() is normally a
+        # single-caller path, but watchdog/exporter threads may drive a
+        # sample concurrently and a torn read-then-write here would
+        # double-count a phase delta
+        with self._lock:
+            last = self._trace_last or {}
+            self._trace_last = totals
 
         def delta(k):
             return max(0, int(totals.get(k, 0) - last.get(k, 0)))
@@ -259,9 +279,16 @@ class StepLogger:
             rec["zero_wire_bytes"] = zero["zero_wire_bytes"] \
                 - last.get("zero_wire_bytes", 0)
             rec["zero_overlap_frac"] = zero["zero_overlap_frac"]
+        embed = self._embed_counters()
+        if embed is not None:
+            elast = self._embed_last or {"embed_wire_bytes": 0}
+            rec["embed_wire_bytes"] = embed["embed_wire_bytes"] \
+                - elast.get("embed_wire_bytes", 0)
+            rec["embed_touched_frac"] = embed["embed_touched_frac"]
         with self._lock:
             self._ckpt_last = ckpt
             self._zero_last = zero
+            self._embed_last = embed
         if extra:
             rec.update(extra)
         self._emit(rec)

@@ -55,8 +55,10 @@ __all__ = ["enabled", "active", "span", "traced", "event", "set_step",
 # analysis/locklint: _step_ctx / _clock / _autodump are written with
 # GIL-atomic dict stores from one control thread (StepLogger.step /
 # dist.barrier / config startup); span-hot readers tolerate one stale
-# value. _phase_us/_phase_n aggregation is held to _phase_lock.
-__analysis_thread_safe__ = {"_step_ctx", "_clock", "_autodump"}
+# value. _phase_us/_phase_n aggregation is held to _phase_lock. _tls is
+# threading.local — every attribute write lands in per-thread storage
+# by construction, so no cross-thread interleaving exists to guard.
+__analysis_thread_safe__ = {"_step_ctx", "_clock", "_autodump", "_tls"}
 
 _tls = threading.local()
 
@@ -97,10 +99,16 @@ def _phase_hist(phase):
     h = _histograms.get(phase)
     if h is None:
         from .registry import histogram
-        h = histogram(f"mxnet_trace_{phase}_seconds",
-                      help=f"traced span durations in the {phase} phase",
-                      buckets=SPAN_BUCKETS)
-        _histograms[phase] = h
+        # double-checked under _phase_lock: spans close on arbitrary
+        # threads, and two racing creators would register twice
+        with _phase_lock:
+            h = _histograms.get(phase)
+            if h is None:
+                h = histogram(
+                    f"mxnet_trace_{phase}_seconds",
+                    help=f"traced span durations in the {phase} phase",
+                    buckets=SPAN_BUCKETS)
+                _histograms[phase] = h
     return h
 
 

@@ -88,6 +88,9 @@ _QUICK = {
     "test_tracing.py::test_flightrec_ring_dump_and_tail",
     "test_zero.py::test_zero1_fp32_bit_identical",
     "test_zero.py::test_resume_across_stage_change",
+    "test_embedding.py::test_rows_adam_matches_dense_restricted",
+    "test_embedding.py::test_kvstore_row_sparse_pull_edge_cases",
+    "test_embedding.py::test_sparse_dense_bit_identity_all_rows_touched",
     "test_analysis.py::test_repo_is_clean_under_strict",
     "test_analysis.py::test_amp_wire_invariant_via_auditor",
     "test_analysis.py::test_tracelint_item_sync_in_scanned_step",

@@ -58,6 +58,9 @@ case "$stage" in
     echo "== zero smoke (ZeRO-1 bitwise parity, fp8 convergence, HLO wire)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.parallel.zero --selftest
+    echo "== embedding smoke (row-sparse exchange parity, resume, HLO wire)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.parallel.embedding --selftest
     echo "== static analysis (tracelint/locklint/commlint/leaklint/configlint/hloaudit, --strict gate)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.analysis --strict ;;
