@@ -1365,6 +1365,38 @@ def _tracing_lane():
             "merge_ms": round(merge_ms, 2)}
 
 
+def _serving_net_lane():
+    """Network serving tier closed-loop (mxnet_tpu.serving.frontend,
+    ISSUE 17): a subprocess HTTP/1.1 server (ThreadingHTTPServer over a
+    ModelRouter with 2 hot models × 2 engine replicas) driven by 64
+    concurrent urllib client threads over real sockets — QPS, p50/p99
+    end-to-end latency, and the shed fraction under mixed
+    interactive/batch admission classes. Subprocess because the server
+    pins its own cpu device set before jax initializes."""
+    import subprocess
+    import sys
+
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving.frontend", "--bench",
+         "--requests", "384" if QUICK else "768", "--concurrency", "64"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "serving_net":
+            rec.pop("metric")
+            return rec
+    raise RuntimeError(
+        f"serving_net bench subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or '').strip()[-300:]}")
+
+
 def _analysis_lane():
     """Static-analysis gate as a measured lane (mxnet_tpu.analysis,
     ISSUE 9): one `python -m mxnet_tpu.analysis --strict --json`
@@ -1703,6 +1735,14 @@ def main(argv=None):
     except Exception as e:
         analysis_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("analysis", analysis_lane)
+    # network serving tier: HTTP closed-loop at concurrency 64 (ISSUE 17)
+    try:
+        serving_net_lane = _gated("serving_net", 120, _serving_net_lane)
+    except _BudgetExceeded:
+        serving_net_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        serving_net_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("serving_net", serving_net_lane)
     acc_fail = None
     try:
         # the accuracy lane ASSERTS its target — never shed silently in a
@@ -1866,6 +1906,14 @@ def main(argv=None):
         "telemetry_overhead_pct": tele_lane.get(
             "overhead_pct", tele_lane.get("status")),
         "telemetry_scrape_ms": tele_lane.get("scrape_ms"),
+        # network serving tier (ISSUE 17): HTTP closed-loop at
+        # concurrency 64 against 2 hot models x 2 replicas (full
+        # payload streamed above as the "serving_net" lane line)
+        "serving_net_qps": serving_net_lane.get(
+            "qps", serving_net_lane.get("status")),
+        "serving_net_p50_ms": serving_net_lane.get("p50_ms"),
+        "serving_net_p99_ms": serving_net_lane.get("p99_ms"),
+        "serving_net_shed_frac": serving_net_lane.get("shed_frac"),
         "timing": ("median-of-3x8-steps (2 dispatches x K=4, cpu-scale)"
                    if CPU_SCALE
                    else "median-of-3x80-steps (20 dispatches x K=4)"),

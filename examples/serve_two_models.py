@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Serve two exported models through the network serving tier.
+
+Exports two tiny .mxa artifacts, starts a `ServingFrontend` (HTTP/1.1,
+docs/SERVING.md "Network tier") with 2 engine replicas per model,
+hot-loads both over the wire, fires a mix of interactive- and
+batch-priority predict requests from concurrent client threads, then
+prints the `/metrics` deltas the run produced (QPS counters, per-class
+shed/timeout series, queue depth).
+
+  python examples/serve_two_models.py
+
+Everything is stdlib + mxnet_tpu: the client side is plain urllib, the
+server a daemon thread in this process — the same code path as
+`python -m mxnet_tpu.serving.frontend a.mxa b.mxa --port 8080`.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.export import export_model
+from mxnet_tpu.serving.frontend import ServingFrontend
+
+
+def export_mlp(dirpath, name, batch=8, in_dim=16, hidden=32):
+    """Tiny MLP -> <dirpath>/<name>.mxa (the serving tier only cares
+    about shapes and compiled-plan sizes here, not trained weights)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (batch, in_dim))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier())
+    args, auxs = mod.get_params()
+    path = os.path.join(dirpath, f"{name}.mxa")
+    export_model(path, sym, args, auxs, {"data": (batch, in_dim)},
+                 model_name=name)
+    return path
+
+
+def http(method, url, body=None, timeout=60):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def scrape(url):
+    """/metrics -> {metric{labels}: value} for delta printing."""
+    with urllib.request.urlopen(f"{url}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="serve2_")
+    paths = {n: export_mlp(tmp, n) for n in ("resnet_toy", "lm_toy")}
+
+    fe = ServingFrontend(replicas=2, buckets=[1, 4, 8])
+    try:
+        u = fe.url
+        for name, path in paths.items():
+            code, body = http("POST", f"{u}/v1/models/{name}:load",
+                              {"path": path})
+            print(f"load {name}: {code} resident_bytes="
+                  f"{body.get('resident_bytes')}")
+        before = scrape(u)
+
+        row = [[0.5] * 16]                     # one (1, 16) input array
+        counts = {}
+        lock = threading.Lock()
+
+        def client(model, priority, n):
+            for _ in range(n):
+                code, _ = http(
+                    "POST", f"{u}/v1/models/{model}:predict",
+                    {"inputs": [row], "priority": priority,
+                     "timeout_ms": 5000})
+                with lock:
+                    counts[(model, priority, code)] = \
+                        counts.get((model, priority, code), 0) + 1
+
+        threads = [threading.Thread(target=client, args=(m, p, 16))
+                   for m in paths for p in ("interactive", "batch")
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        print("request outcomes (model, priority, status): ")
+        for k in sorted(counts):
+            print(f"  {k}: {counts[k]}")
+
+        print("/metrics deltas:")
+        after = scrape(u)
+        for key in sorted(after):
+            delta = after[key] - before.get(key, 0.0)
+            if delta:
+                print(f"  {key}: +{delta:g}")
+
+        code, body = http("GET", f"{u}/v1/models")
+        print(f"hot models: {body.get('models')}")
+        ok = all(c == 200 for (_, _, c) in counts)
+        return 0 if ok else 1
+    finally:
+        fe.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -204,6 +204,17 @@ _DOCUMENTED = {
     "MXNET_DEVSTATS_PEAK_GBPS": None,
     "MXNET_DEVSTATS_HBM_BYTES": None,
     "MXNET_DEVSTATS_RECOMPILE_LIMIT": 32,
+    # network serving tier (mxnet_tpu.serving.frontend, docs/SERVING.md):
+    # MXNET_SERVING_PORT=<port> is the HTTP front-door default bind;
+    # MXNET_SERVING_REPLICAS sets the EnginePool replica count per model;
+    # MXNET_SERVING_HBM_BUDGET=<bytes> caps the ModelRouter's summed
+    # plan-cache footprint (admission preflight + LRU eviction; unset
+    # falls back to MXNET_DEVSTATS_HBM_BYTES / the PJRT bytes_limit);
+    # MXNET_SERVING_MAX_MODELS bounds the hot-model table (0 = unbounded)
+    "MXNET_SERVING_PORT": None,
+    "MXNET_SERVING_REPLICAS": 1,
+    "MXNET_SERVING_HBM_BUDGET": None,
+    "MXNET_SERVING_MAX_MODELS": 0,
 }
 
 

@@ -164,19 +164,22 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     # predictors ignore the key.
     batch_sizes = {int(data_shapes[n][0]) for n in input_names
                    if len(data_shapes[n]) > 0}
+    if model_name is None:
+        model_name = os.path.splitext(os.path.basename(str(path)))[0] \
+            or "model"
     serving_meta = None
     if len(batch_sizes) == 1:
         max_batch = batch_sizes.pop()
         # amp_dtype records the COMPUTE dtype baked into the StableHLO
         # module; request/response I/O stays fp32 regardless (the casts
         # live inside `fn` above, so serving's bucket plans fuse them
-        # into each jitted pad->call->slice program)
+        # into each jitted pad->call->slice program); "model" rides in
+        # the serving block too so routing layers that only crack this
+        # block still get the name
         serving_meta = {"batch_axis": 0, "max_batch": max_batch,
                         "buckets": serving_buckets(max_batch),
-                        "amp_dtype": dtype}
-    if model_name is None:
-        model_name = os.path.splitext(os.path.basename(str(path)))[0] \
-            or "model"
+                        "amp_dtype": dtype,
+                        "model": str(model_name)}
     manifest = {
         "format_version": FORMAT_VERSION,
         "model_name": str(model_name),

@@ -10,8 +10,18 @@ Composition (each piece is independently usable):
                            max_batch / max_wait_us over a bounded queue,
                            with per-request deadlines and load shedding.
     metrics.ServingMetrics QPS / p50 / p99 / batch histogram / queue
-                           depth / shed count, exported through
-                           mx.profiler's counter-export hook.
+                           depth / shed count (per admission class),
+                           exported through mx.profiler's counter-export
+                           hook.
+    pool.EnginePool        R engine replicas with distinct plan caches
+                           behind least-loaded dispatch.
+    router.ModelRouter     many hot models in one process: HBM-budgeted
+                           admission preflight + LRU eviction by
+                           measured plan_resident_bytes.
+    frontend.ServingFrontend
+                           the network tier: stdlib HTTP/1.1 JSON front
+                           door (predict/load/unload//metrics) over a
+                           ModelRouter — docs/SERVING.md "Network tier".
 
 Quick start:
 
@@ -22,16 +32,36 @@ Quick start:
         out = bat.infer(x_row)                        # from any thread
     print(bat.metrics.to_json())
 
+    fe = serving.ServingFrontend(port=8080, replicas=2)
+    fe.router.load("resnet", "resnet.mxa")
+    # POST http://127.0.0.1:8080/v1/models/resnet:predict
+
 CLI: `python -m mxnet_tpu.serving model.mxa --selftest` runs a
 closed-loop load generator against the batcher and prints a one-line
-perf JSON (tiny built-in convnet when no artifact is given).
+perf JSON (tiny built-in convnet when no artifact is given);
+`python -m mxnet_tpu.serving.frontend --selftest` drives the whole
+network tier through real sockets.
 """
 from __future__ import annotations
 
 from .engine import ServingEngine
-from .batcher import (DynamicBatcher, Future, RequestTimeout,
-                      ServingQueueFull)
+from .batcher import (ADMISSION_CLASSES, DynamicBatcher, Future,
+                      RequestTimeout, ServingQueueFull)
 from .metrics import ServingMetrics
+from .pool import EnginePool
+from .router import ModelRouter, UnknownModel
+
+
+def __getattr__(name):
+    # lazy: `python -m mxnet_tpu.serving.frontend` would otherwise see
+    # frontend in sys.modules before runpy executes it (RuntimeWarning)
+    if name == "ServingFrontend":
+        from .frontend import ServingFrontend
+        return ServingFrontend
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = ["ServingEngine", "DynamicBatcher", "ServingMetrics",
-           "Future", "RequestTimeout", "ServingQueueFull"]
+           "Future", "RequestTimeout", "ServingQueueFull",
+           "ADMISSION_CLASSES", "EnginePool", "ModelRouter",
+           "UnknownModel", "ServingFrontend"]

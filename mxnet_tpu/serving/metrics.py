@@ -22,7 +22,11 @@ depth, request latency and the engine's compiled-plan cache footprint
 `record_plan_bytes`) additionally feed native registry series so
 Prometheus sees real cumulative-bucket distributions, not just window
 percentiles. When the engine's .mxa manifest names the model, every
-native series carries a `model="<name>"` label.
+native series carries a `model="<name>"` label (plus `replica="N"` in
+an EnginePool). Shed and timeout totals are native counters too — keyed
+per admission class (`class="interactive"|"batch"`) — so the labels
+survive even when a request dies in the batcher before any engine is
+bound to it.
 """
 from __future__ import annotations
 
@@ -40,18 +44,22 @@ class ServingMetrics:
     _seq = 0
     _seq_lock = threading.Lock()
 
-    def __init__(self, name="serving", latency_window=4096, model=None):
+    def __init__(self, name="serving", latency_window=4096, model=None,
+                 replica=None):
         with ServingMetrics._seq_lock:
             ServingMetrics._seq += 1
             seq = ServingMetrics._seq
         self.name = name if seq == 1 else f"{name}#{seq}"
         self.model = str(model) if model else None
+        self.replica = None if replica is None else int(replica)
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.requests = 0          # accepted submits
         self.completed = 0         # futures resolved with a result
         self.shed = 0              # rejected at submit (queue full)
         self.timeouts = 0          # expired before execution
+        self.shed_by_class = {}    # admission class -> shed count
+        self.timeouts_by_class = {}
         self.errors = 0            # engine raised; future got the error
         self.batches = 0           # compiled-plan invocations
         self.batched_rows = 0      # rows across all batches
@@ -68,9 +76,17 @@ class ServingMetrics:
         # model name from the .mxa manifest rides as a constant label so
         # a multi-model process gets distinguishable series without the
         # model leaking into metric names
-        from ..telemetry import gauge, histogram
+        from ..telemetry import counter, gauge, histogram
+        self._counter = counter
         mname = self.name.replace("#", "_")
-        labels = {"model": self.model} if self.model else None
+        labels = {}
+        if self.model:
+            labels["model"] = self.model
+        if self.replica is not None:
+            labels["replica"] = str(self.replica)
+        labels = labels or None
+        self._mname = mname
+        self._base_labels = dict(labels or {})
         self._g_depth = gauge(
             f"mxnet_{mname}_queue_depth",
             help="live dynamic-batcher queue size", labels=labels)
@@ -81,6 +97,27 @@ class ServingMetrics:
             f"mxnet_{mname}_plan_resident_bytes",
             help="bytes resident in the engine's compiled bucket-plan "
                  "cache (devstats accounting)", labels=labels)
+        # per-class shed/timeout counters created lazily on first record,
+        # one `series=` per admission class under a shared metric name —
+        # the model/replica/class labels ride on EVERY shed or timeout,
+        # including requests shed at submit before an engine is bound
+        self._c_shed_cls = {}
+        self._c_timeout_cls = {}
+
+    def _class_counter(self, table, what, klass):
+        """Get-or-create the per-class counter. Caller holds self._lock
+        (the registry's own get-or-create makes a race merely wasteful,
+        but the table write must be guarded like every other field)."""
+        c = table.get(klass)
+        if c is None:
+            labels = dict(self._base_labels)
+            labels["class"] = klass
+            c = self._counter(
+                f"mxnet_{self._mname}_{what}_total",
+                help=f"requests {what} per admission class",
+                labels=labels, series=klass)
+            table[klass] = c
+        return c
 
     def close(self):
         profiler.unregister_counter_export(self.name)
@@ -91,14 +128,21 @@ class ServingMetrics:
         with self._lock:
             self.requests += 1
 
-    def record_shed(self):
+    def record_shed(self, klass="interactive"):
         with self._lock:
             self.shed += 1
+            self.shed_by_class[klass] = self.shed_by_class.get(klass, 0) + 1
+            c = self._class_counter(self._c_shed_cls, "shed", klass)
+        c.inc()
         self._c_shed.increment()
 
-    def record_timeout(self):
+    def record_timeout(self, klass="interactive"):
         with self._lock:
             self.timeouts += 1
+            self.timeouts_by_class[klass] = \
+                self.timeouts_by_class.get(klass, 0) + 1
+            c = self._class_counter(self._c_timeout_cls, "timeout", klass)
+        c.inc()
 
     def record_error(self):
         with self._lock:
@@ -151,6 +195,8 @@ class ServingMetrics:
                 "completed": self.completed,
                 "shed": self.shed,
                 "timeouts": self.timeouts,
+                "shed_by_class": dict(self.shed_by_class),
+                "timeouts_by_class": dict(self.timeouts_by_class),
                 "errors": self.errors,
                 "batches": self.batches,
                 "batched_rows": self.batched_rows,

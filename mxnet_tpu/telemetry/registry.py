@@ -237,7 +237,14 @@ def _with_labels(line, labels):
 class Registry:
     """Name -> metric store. `counter/gauge/histogram` are get-or-create
     (same name + same kind returns the existing instance, so any module
-    can grab a handle without coordination; a kind clash raises)."""
+    can grab a handle without coordination; a kind clash raises).
+
+    `series=` registers ANOTHER instance under the same metric name —
+    the Prometheus shape of one name rendered with different constant
+    label sets (serving's `shed_total{class="interactive"}` vs
+    `{class="batch"}`). The store key becomes (name, series); rendering
+    emits the HELP/TYPE header once per name and every series' samples
+    under it."""
 
     def __init__(self, absorb_profiler=True):
         self._lock = threading.Lock()
@@ -265,10 +272,11 @@ class Registry:
 
     # -- creation -----------------------------------------------------------
 
-    def _get_or_create(self, cls, name, help, **kw):
+    def _get_or_create(self, cls, name, help, series=None, **kw):
         name = _sanitize(name)
+        key = name if series is None else f"{name}\x00{series}"
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is not None:
                 if not isinstance(m, cls):
                     raise ValueError(
@@ -276,22 +284,33 @@ class Registry:
                         f"requested {cls.kind}")
                 return m
             m = cls(name, help=help, **kw)
-            self._metrics[name] = m
+            # snapshot()/back-export need one flat key per instance;
+            # the rendered metric NAME stays shared across series
+            m.snapshot_name = name if series is None \
+                else _sanitize(f"{name}__{series}")
+            self._metrics[key] = m
             return m
 
-    def counter(self, name, help="", labels=None):
-        return self._get_or_create(Counter, name, help, labels=labels)
-
-    def gauge(self, name, help="", labels=None):
-        return self._get_or_create(Gauge, name, help, labels=labels)
-
-    def histogram(self, name, help="", buckets=None, labels=None):
-        return self._get_or_create(Histogram, name, help, buckets=buckets,
+    def counter(self, name, help="", labels=None, series=None):
+        return self._get_or_create(Counter, name, help, series=series,
                                    labels=labels)
 
+    def gauge(self, name, help="", labels=None, series=None):
+        return self._get_or_create(Gauge, name, help, series=series,
+                                   labels=labels)
+
+    def histogram(self, name, help="", buckets=None, labels=None,
+                  series=None):
+        return self._get_or_create(Histogram, name, help, series=series,
+                                   buckets=buckets, labels=labels)
+
     def unregister(self, name):
+        """Drop a metric and every labeled series registered under it."""
+        name = _sanitize(name)
         with self._lock:
-            self._metrics.pop(_sanitize(name), None)
+            for key in [k for k in self._metrics
+                        if k == name or k.startswith(name + "\x00")]:
+                self._metrics.pop(key, None)
 
     def get(self, name):
         with self._lock:
@@ -308,7 +327,8 @@ class Registry:
         metrics only — this is what flows back into profiler.dump() via
         the "telemetry" counter-export hook (no recursion: absorbed
         hooks are not re-exported)."""
-        return {m.name: m._snapshot() for m in self.own_metrics()}
+        return {getattr(m, "snapshot_name", m.name): m._snapshot()
+                for m in self.own_metrics()}
 
     def absorbed(self):
         """Snapshot of every profiler counter-export hook except our own
@@ -334,9 +354,11 @@ class Registry:
         lines = []
         seen = set()
         for m in self.own_metrics():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+            # series instances share a metric name: header once per name
+            if m.name not in seen:
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m._render())
             seen.add(m.name)
         for hook, snap in sorted(self.absorbed().items()):
@@ -397,14 +419,16 @@ def get_registry():
     return _default
 
 
-def counter(name, help="", labels=None):
-    return get_registry().counter(name, help=help, labels=labels)
+def counter(name, help="", labels=None, series=None):
+    return get_registry().counter(name, help=help, labels=labels,
+                                  series=series)
 
 
-def gauge(name, help="", labels=None):
-    return get_registry().gauge(name, help=help, labels=labels)
+def gauge(name, help="", labels=None, series=None):
+    return get_registry().gauge(name, help=help, labels=labels,
+                                series=series)
 
 
-def histogram(name, help="", buckets=None, labels=None):
+def histogram(name, help="", buckets=None, labels=None, series=None):
     return get_registry().histogram(name, help=help, buckets=buckets,
-                                    labels=labels)
+                                    labels=labels, series=series)

@@ -91,6 +91,12 @@ _QUICK = {
     "test_embedding.py::test_rows_adam_matches_dense_restricted",
     "test_embedding.py::test_kvstore_row_sparse_pull_edge_cases",
     "test_embedding.py::test_sparse_dense_bit_identity_all_rows_touched",
+    "test_frontend.py::test_router_lru_eviction_order_by_resident_bytes",
+    "test_frontend.py::"
+    "test_preflight_rejected_load_leaves_router_state_unchanged",
+    "test_frontend.py::test_least_loaded_dispatch_picks_idle_replica",
+    "test_frontend.py::test_admission_class_shed_ordering",
+    "test_frontend.py::test_http_status_mapping",
     "test_analysis.py::test_repo_is_clean_under_strict",
     "test_analysis.py::test_amp_wire_invariant_via_auditor",
     "test_analysis.py::test_tracelint_item_sync_in_scanned_step",

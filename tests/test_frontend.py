@@ -1,0 +1,412 @@
+"""mxnet_tpu.serving network tier (ISSUE 17): ModelRouter HBM-aware
+LRU admission, EnginePool least-loaded dispatch, admission-class shed
+ordering, and the HTTP front door's status mapping — fake engines/pools
+for the deterministic scheduling contracts, one real .mxa end-to-end.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.serving import (DynamicBatcher, EnginePool, ModelRouter,
+                               ServingQueueFull, UnknownModel)
+from mxnet_tpu.serving.batcher import RequestTimeout
+from mxnet_tpu.serving.frontend import ServingFrontend, status_for
+from mxnet_tpu.telemetry import devstats
+
+
+class FakeEngine:
+    """Identity engine, optionally gated so a replica stays busy."""
+
+    def __init__(self, max_batch=8, gate=None, model_name=None):
+        self.max_batch = max_batch
+        self.input_names = ["data"]
+        self.gate = gate
+        self.model_name = model_name
+        self.calls = 0
+        self.seen = []                    # first scalar of each batch
+
+    def infer(self, x):
+        self.calls += 1
+        self.seen.append(float(np.asarray(x).flat[0]))
+        if self.gate is not None:
+            self.gate.wait(timeout=10)
+        return [np.asarray(x)]
+
+
+class _FakeFuture:
+    def __init__(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class FakePool:
+    """Router-facing pool double: fixed resident bytes, scripted
+    predict behavior, close-exactly-once accounting."""
+
+    def __init__(self, path, resident=0, behavior="ok"):
+        self.path = path
+        self.resident = resident
+        self.behavior = behavior
+        self.model_name = "fake"
+        self.closed = 0
+        self._lock = threading.Lock()
+
+    def resident_bytes(self):
+        return self.resident
+
+    def plan_compiles(self):
+        return 1
+
+    def depth(self):
+        return 0
+
+    def stats(self):
+        return {"model": self.model_name, "replicas": 1, "depth": 0,
+                "resident_bytes": self.resident, "plans": 1,
+                "requests": 0, "completed": 0, "shed": 0, "timeouts": 0,
+                "per_replica": []}
+
+    def submit(self, *arrays, timeout_ms=None, priority="interactive"):
+        if self.behavior == "shed":
+            raise ServingQueueFull("scripted shed")
+        if self.behavior == "timeout":
+            return _FakeFuture(exc=RequestTimeout("scripted timeout")), 0
+        return _FakeFuture(value=[np.asarray(a) for a in arrays]), 0
+
+    def close(self, drain=True):
+        with self._lock:
+            self.closed += 1
+
+
+def _fake_router(sizes, budget=None, max_models=0, behaviors=None,
+                 created=None):
+    """ModelRouter over FakePools: `sizes[path]` is both the admission
+    estimate (need_fn) and the measured resident."""
+    behaviors = behaviors or {}
+
+    def factory(path, replicas=1):
+        p = FakePool(path, resident=sizes[path],
+                     behavior=behaviors.get(path, "ok"))
+        if created is not None:
+            created.append(p)
+        return p
+
+    return ModelRouter(budget=budget, max_models=max_models, replicas=1,
+                       pool_factory=factory,
+                       need_fn=lambda path: sizes[path])
+
+
+# --------------------------------------------------------------- router
+
+
+def test_router_lru_eviction_order_by_resident_bytes():
+    sizes = {"p1": 40, "p2": 40, "p3": 40, "p4": 100}
+    created = []
+    r = _fake_router(sizes, budget=100, created=created)
+    r.load("m1", "p1")
+    r.load("m2", "p2")
+    assert r.models() == ["m1", "m2"]
+    assert r.resident_bytes() == 80
+    # touch m1 so m2 becomes the LRU victim
+    r.predict("m1", [np.zeros((1, 2), np.float32)]).result()
+    r.load("m3", "p3")                    # 80 + 40 > 100: evict ONE
+    assert set(r.models()) == {"m1", "m3"}
+    assert created[1].closed == 1 and created[0].closed == 0
+    # a model that needs the whole budget evicts everything LRU-first
+    r.load("m4", "p4")
+    assert r.models() == ["m4"]
+    assert [p.closed for p in created] == [1, 1, 1, 0]
+    r.close()
+    assert created[3].closed == 1
+
+
+def test_preflight_rejected_load_leaves_router_state_unchanged():
+    sizes = {"small": 40, "huge": 1000}
+    created = []
+    r = _fake_router(sizes, budget=100, created=created)
+    r.load("m1", "small")
+    before = (r.models(), r.resident_bytes())
+    with pytest.raises(devstats.HBMPreflightError):
+        r.load("whale", "huge")           # estimate alone > budget
+    # rejected BEFORE eviction and BEFORE any pool was built
+    assert (r.models(), r.resident_bytes()) == before
+    assert len(created) == 1 and created[0].closed == 0
+    with pytest.raises(UnknownModel):
+        r.predict("whale", [np.zeros((1, 2), np.float32)])
+    r.close()
+
+
+def test_router_max_models_bound_evicts_lru():
+    sizes = {"p1": 1, "p2": 1, "p3": 1}
+    created = []
+    r = _fake_router(sizes, max_models=2, created=created)
+    r.load("m1", "p1")
+    r.load("m2", "p2")
+    r.load("m3", "p3")
+    assert set(r.models()) == {"m2", "m3"}
+    assert created[0].closed == 1
+    r.close()
+
+
+def test_concurrent_load_unload_races():
+    sizes = {f"p{i}": 10 for i in range(4)}
+    created = []
+    r = _fake_router(sizes, created=created)
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def churn(k):
+        name, path = f"m{k % 2}", f"p{k % 4}"
+        while time.monotonic() < stop:
+            try:
+                r.load(name, path)
+                r.predict(name,
+                          [np.zeros((1, 2), np.float32)]).result()
+                r.unload(name)
+            except (UnknownModel, RuntimeError):
+                pass                      # lost a race: fine
+            except Exception as e:        # pragma: no cover
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=churn, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    r.close()
+    assert not errors
+    assert created, "no pools were ever built"
+    # every pool the router ever built is closed exactly once
+    assert all(p.closed == 1 for p in created), \
+        [(p.path, p.closed) for p in created]
+
+
+# ----------------------------------------------------------------- pool
+
+
+def test_least_loaded_dispatch_picks_idle_replica():
+    gates = [threading.Event(), threading.Event()]
+    pool = EnginePool(
+        "x", replicas=2,
+        engine_factory=lambda model, replica: FakeEngine(
+            gate=gates[replica]),
+        max_wait_us=0)
+    try:
+        f0, i0 = pool.submit(np.zeros((1, 2), np.float32))
+        # wait until the worker has TAKEN it (depth = inflight, not
+        # queued) so the replica reads as busy, then dispatch again
+        deadline = time.monotonic() + 5
+        while pool.engines[i0].calls == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert pool.batchers[i0].depth() == 1
+        f1, i1 = pool.submit(np.zeros((1, 2), np.float32))
+        assert i1 != i0, "dispatch piled onto the busy replica"
+        for g in gates:
+            g.set()
+        assert f0.result(timeout=10)[0].shape == (1, 2)
+        assert f1.result(timeout=10)[0].shape == (1, 2)
+    finally:
+        for g in gates:
+            g.set()
+        pool.close()
+
+
+# -------------------------------------------------------- admission class
+
+
+def test_admission_class_shed_ordering():
+    gate = threading.Event()
+    eng = FakeEngine(max_batch=4, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=0, queue_depth=4,
+                       batch_queue_depth=1)
+    try:
+        first = b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5
+        while eng.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)             # worker now blocked in infer
+        ok_batch = b.submit(np.zeros((1, 2), np.float32),
+                            priority="batch")
+        with pytest.raises(ServingQueueFull):
+            b.submit(np.zeros((1, 2), np.float32), priority="batch")
+        # interactive still has headroom after batch started shedding
+        ok_inter = b.submit(np.zeros((1, 2), np.float32))
+        snap = b.metrics.snapshot()
+        assert snap["shed_by_class"] == {"batch": 1}
+        assert snap["shed"] == 1
+        gate.set()
+        for f in (first, ok_batch, ok_inter):
+            f.result(timeout=10)
+        # the per-class counter reached the registry with class labels
+        from mxnet_tpu.telemetry import get_registry
+        text = get_registry().render_prometheus()
+        assert any("shed_total" in ln and 'class="batch"' in ln
+                   for ln in text.splitlines()
+                   if not ln.startswith("#"))
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_interactive_drained_before_batch():
+    gate = threading.Event()
+    eng = FakeEngine(max_batch=1, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=0, queue_depth=8,
+                       batch_queue_depth=8)
+    try:
+        first = b.submit(np.full((1, 1), 0.0, np.float32))
+        deadline = time.monotonic() + 5
+        while eng.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)             # worker blocked on request 0
+        fb = b.submit(np.full((1, 1), 1.0, np.float32),
+                      priority="batch")
+        fi = b.submit(np.full((1, 1), 2.0, np.float32))
+        gate.set()
+        for f in (first, fb, fi):
+            f.result(timeout=10)
+        # max_batch=1: each request ran alone, and the later-queued
+        # interactive one (2.0) was taken before the batch one (1.0)
+        assert eng.seen == [0.0, 2.0, 1.0]
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_timeout_records_class():
+    gate = threading.Event()
+    eng = FakeEngine(max_batch=1, gate=gate)
+    b = DynamicBatcher(eng, max_wait_us=0, queue_depth=8,
+                       batch_queue_depth=8)
+    try:
+        first = b.submit(np.zeros((1, 1), np.float32))
+        deadline = time.monotonic() + 5
+        while eng.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        doomed = b.submit(np.zeros((1, 1), np.float32),
+                          priority="batch", timeout_ms=10)
+        time.sleep(0.05)                  # let the deadline lapse queued
+        gate.set()
+        first.result(timeout=10)
+        with pytest.raises(RequestTimeout):
+            doomed.result(timeout=10)
+        deadline = time.monotonic() + 5
+        while not b.metrics.snapshot()["timeouts"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.metrics.snapshot()["timeouts_by_class"] == {"batch": 1}
+    finally:
+        gate.set()
+        b.close()
+
+
+# ------------------------------------------------------------- frontend
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def test_http_status_mapping():
+    sizes = {"ok": 10, "shed": 10, "slow": 10}
+    r = _fake_router(sizes, behaviors={"shed": "shed",
+                                       "slow": "timeout"})
+    fe = ServingFrontend(router=r)
+    try:
+        u = fe.url
+        for name in sizes:
+            assert _post(f"{u}/v1/models/{name}:load",
+                         {"path": name})[0] == 200
+        row = {"inputs": [[[1.0, 2.0]]]}
+        code, out = _post(f"{u}/v1/models/ok:predict", row)
+        assert code == 200 and out["outputs"] == [[[1.0, 2.0]]]
+        assert _post(f"{u}/v1/models/ghost:predict", row)[0] == 404
+        assert _post(f"{u}/v1/models/shed:predict", row)[0] == 429
+        assert _post(f"{u}/v1/models/slow:predict", row)[0] == 504
+        assert _post(f"{u}/v1/models/ok:predict", {})[0] == 400
+        assert _post(f"{u}/v1/models/ok:frobnicate", {})[0] == 400
+        assert _post(f"{u}/v1/models/ghost:unload", {})[0] == 404
+        assert _get(f"{u}/healthz")[0] == 200
+        assert _get(f"{u}/metrics")[0] == 200
+        assert _get(f"{u}/nope")[0] == 404
+        code, models = _post(f"{u}/v1/models/ok:unload", {})
+        assert code == 200
+        assert _post(f"{u}/v1/models/ok:predict", row)[0] == 404
+    finally:
+        fe.close()
+        r.close()
+
+
+def test_status_for_exception_order():
+    # the serving exceptions subclass stdlib ones; mapping must see the
+    # specific class first
+    assert status_for(UnknownModel("x")) == 404        # KeyError
+    assert status_for(ServingQueueFull("x")) == 429    # RuntimeError
+    assert status_for(RequestTimeout("x")) == 504      # TimeoutError
+    assert status_for(devstats.HBMPreflightError("x")) == 507
+    assert status_for(ValueError("x")) == 400
+    assert status_for(KeyError("x")) == 400
+    assert status_for(RuntimeError("x")) == 409
+    assert status_for(Exception("x")) == 500
+
+
+def test_frontend_close_idempotent_and_joined():
+    r = _fake_router({"p": 1})
+    fe = ServingFrontend(router=r)
+    fe.close()
+    fe.close()                            # idempotent
+    assert not fe._thread.is_alive()
+    r.close()
+
+
+def test_frontend_end_to_end_matches_engine(tmp_path):
+    from mxnet_tpu.serving import ServingEngine
+    from mxnet_tpu.serving.frontend import _export_mlp
+    path = _export_mlp(str(tmp_path), "e2e")
+    eng = ServingEngine(path, buckets=[1, 8])
+    row = np.linspace(0, 1, 16, dtype=np.float32).reshape(1, 16)
+    want = eng.infer(row)[0]
+    fe = ServingFrontend(replicas=1, buckets=[1, 8])
+    try:
+        assert _post(f"{fe.url}/v1/models/e2e:load",
+                     {"path": path})[0] == 200
+        code, out = _post(f"{fe.url}/v1/models/e2e:predict",
+                          {"inputs": [row.tolist()],
+                           "timeout_ms": 30000})
+        assert code == 200
+        got = np.asarray(out["outputs"][0], np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # dict-shaped inputs resolve by input name too
+        code, out2 = _post(f"{fe.url}/v1/models/e2e:predict",
+                           {"inputs": {"data": row.tolist()}})
+        assert code == 200
+        np.testing.assert_allclose(
+            np.asarray(out2["outputs"][0], np.float32), want,
+            rtol=1e-5, atol=1e-6)
+    finally:
+        fe.close()

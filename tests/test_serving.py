@@ -81,7 +81,8 @@ def test_manifest_serving_metadata(artifact):
     pred = Predictor(artifact)
     meta = pred.manifest["serving"]
     assert meta == {"batch_axis": 0, "max_batch": BATCH,
-                    "buckets": [1, 2, 4, 8], "amp_dtype": "float32"}
+                    "buckets": [1, 2, 4, 8], "amp_dtype": "float32",
+                    "model": "model"}
     assert pred.export_batch == BATCH
     assert serving_buckets(6) == [1, 2, 4, 6]
     assert serving_buckets(1) == [1]

@@ -30,6 +30,9 @@ case "$stage" in
     echo "== serving smoke (dynamic-batching selftest, tiny convnet)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.serving --selftest --requests 128
+    echo "== serving frontend smoke (HTTP tier: 64 clients, shed order, LRU)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.serving.frontend --selftest --requests 192
     echo "== device-feed smoke (async pipeline overlap selftest)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.pipeline --selftest
