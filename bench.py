@@ -450,52 +450,135 @@ def _flash_attention_tokens_per_sec(batch=8, heads=8, seq=4096, dim=128):
     return _median(rates), flops / (batch * seq)   # per token
 
 
-def _int8_inference_ips(sym):
-    """INT8 ResNet-50 b32 inference lane. Known SLOWER than bf16 on this
-    chip — XLA's int8 convs run ~3x less byte-efficient than bf16 and
-    the per-layer dequant/requant chains add ~1 GB/batch; the lane exists
-    so the gap stays measured, not assumed (trace evidence and the
-    parking decision: docs/int8_r04.md). Weights are random — ranges come
-    from calibration either way and throughput is weight-agnostic."""
-    import jax
-    import jax.numpy as jnp
+def _quantized_serving_lane():
+    """End-to-end quantized serving A/B (ISSUE 18): the same MLP
+    exported twice — bf16 weights vs int8 weight-only calibration baked
+    into the `.mxa` manifest — both served through ServingEngine, so
+    the measured delta includes the whole path the artifact actually
+    runs (container load, scale-companion params, fused dequant
+    matmul). Replaces the parked XLA-conv int8 lane (docs/int8_r04.md):
+    weight-only serving is the int8 shape this codebase ships, and it
+    runs on every backend, so the lane is no longer chip-gated."""
+    import tempfile
     import mxnet_tpu as mx
-    from mxnet_tpu.contrib.quantization import quantize_model
-    from mxnet_tpu.executor import _build_runner
+    from mxnet_tpu.contrib.export import export_model
+    from mxnet_tpu.serving import ServingEngine
 
     rng = np.random.RandomState(0)
-    shapes = {"data": (INFER_BATCH, 3, 224, 224),
-              "softmax_label": (INFER_BATCH,)}
-    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
-    arg_params = {
-        n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
-        for n, s in zip(sym.list_arguments(), arg_shapes)
-        if n not in ("data", "softmax_label")}
-    aux_params = {
-        n: mx.nd.array((np.zeros if ("mean" in n or "beta" in n)
-                        else np.ones)(s).astype(np.float32))
-        for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
-    calib = mx.io.NDArrayIter(
-        rng.uniform(0, 1, (32, 3, 224, 224)).astype(np.float32),
-        np.zeros(32, np.float32), batch_size=INFER_BATCH,
-        label_name="softmax_label")
-    qsym, qargs, qaux = quantize_model(
-        sym, arg_params, aux_params, calib_mode="naive", calib_data=calib,
-        num_calib_examples=32)
-    run = _build_runner(qsym, is_train=False)
-    tpu = jax.devices()[0]
-    x = jnp.asarray(rng.uniform(0, 1, (INFER_BATCH, 3, 224, 224))
-                    .astype(np.float32))
-    argv = tuple(jax.device_put(
-        qargs[n]._data if n in qargs else
-        (x if n == "data" else jnp.zeros(INFER_BATCH, jnp.float32)), tpu)
-        for n in qsym.list_arguments())
-    auxv = tuple(jax.device_put(qaux[n]._data, tpu)
-                 for n in qsym.list_auxiliary_states())
-    key = jax.device_put(jax.random.PRNGKey(0), tpu)
-    # same timing harness (warmup + host-fetch barrier + median-of-3)
-    # as every other inference lane
-    return _infer_ips(run, argv, auxv, key)[0]
+    d_in, d_h, d_out, batch = 256, 1024, 256, 32
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=d_h, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=d_h, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=d_out, name="fc3")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes = {"data": (batch, d_in), "softmax_label": (batch,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    args = {n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    x = rng.uniform(-1, 1, (batch, d_in)).astype(np.float32)
+
+    def _serve_ips(path):
+        eng = ServingEngine(path, buckets=(batch,))
+        try:
+            out = np.asarray(eng.infer(x))
+            iters = 20 if QUICK else 60
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    last = eng.infer(x)
+                np.asarray(last)        # host-fetch barrier
+                rates.append(iters * batch
+                             / (time.perf_counter() - t0))
+            return _median(rates), out
+        finally:
+            eng.close() if hasattr(eng, "close") else None
+
+    res = {"batch": batch}
+    with tempfile.TemporaryDirectory() as td:
+        p16 = os.path.join(td, "mlp_bf16.mxa")
+        p8 = os.path.join(td, "mlp_int8.mxa")
+        export_model(p16, sym, args, {}, {"data": (batch, d_in)},
+                     dtype="bfloat16")
+        export_model(p8, sym, args, {}, {"data": (batch, d_in)},
+                     dtype="bfloat16", quantize="int8")
+        import zipfile
+        with zipfile.ZipFile(p8) as z:
+            quant = json.loads(
+                z.read("MANIFEST.json")).get("quant") or {}
+        bf16_ips, out16 = _serve_ips(p16)
+        int8_ips, out8 = _serve_ips(p8)
+    res.update({
+        "bf16_ips": round(bf16_ips, 1),
+        "int8_ips": round(int8_ips, 1),
+        "int8_vs_bf16": round(int8_ips / bf16_ips, 3),
+        # softmax outputs: the quantization error the artifact ships
+        "max_abs_err": float(np.abs(out16 - out8).max()),
+        "quantized_params": len(quant.get("params", []))})
+    return res
+
+
+def _decode_lane():
+    """Continuous-batching decode (ISSUE 18): one DecodeEngine, its ONE
+    compiled step plan advancing whatever sessions are live — measured
+    at 1/8/32 concurrent sessions. Reports aggregate tokens/s, p50/p99
+    per-token latency seen by a session (submit→done wall over tokens
+    emitted: queueing + prefill + its share of every packed step), and
+    the KV-pool occupancy the wave actually reached."""
+    from mxnet_tpu.serving.decode import DecodeEngine, DecodeModel
+
+    rng = np.random.RandomState(7)
+    model = DecodeModel(vocab=256, layers=2, d_model=128, heads=4,
+                        kv_heads=2, d_ff=256, max_len=128)
+    params = model.init_params(seed=0)
+    eng = DecodeEngine(model, params, num_slots=32,
+                       name="bench-decode", warmup=True)
+    new_tokens = 16 if QUICK else 32
+    res = {"num_slots": eng.num_slots, "max_len": eng.max_len,
+           "new_tokens": new_tokens, "levels": {}}
+    try:
+        # warm BOTH prefill buckets the prompt lengths below hit (8 and
+        # 16), so no level pays a first-compile mid-wave
+        eng.generate(list(rng.randint(1, 256, 8)), max_new_tokens=2)
+        eng.generate(list(rng.randint(1, 256, 12)), max_new_tokens=2)
+        for conc in (1, 8, 32):
+            prompts = [list(map(int, rng.randint(1, 256,
+                                                 8 + (i % 5))))
+                       for i in range(conc)]
+            t0 = time.perf_counter()
+            sess = [eng.submit(p, max_new_tokens=new_tokens)
+                    for p in prompts]
+            # peak occupancy while the wave is in flight: how full the
+            # continuous batch actually ran
+            occ = 0
+            while not all(s.future.done() for s in sess):
+                occ = max(occ, eng.pool.occupancy())
+                time.sleep(0.001)
+            outs = [s.result() for s in sess]
+            wall = time.perf_counter() - t0
+            per_tok = sorted((s.t_done - s.t_submit) / len(o)
+                             for s, o in zip(sess, outs))
+            n_tok = sum(len(o) for o in outs)
+            res["levels"][str(conc)] = {
+                "tokens_per_s": round(n_tok / wall, 1),
+                "per_token_p50_ms": round(
+                    per_tok[len(per_tok) // 2] * 1e3, 3),
+                "per_token_p99_ms": round(
+                    per_tok[min(len(per_tok) - 1,
+                                int(len(per_tok) * 0.99))] * 1e3, 3),
+                "kv_occupancy": occ}
+        s1 = res["levels"]["1"]["tokens_per_s"]
+        s32 = res["levels"]["32"]["tokens_per_s"]
+        res["batching_speedup_32v1"] = round(s32 / s1, 2)
+        res["step_executions"] = eng.step_executions
+        res["plan_compiles"] = eng.plan_compiles
+        res["kv_cache_bytes"] = eng.cache_bytes
+    finally:
+        eng.close(drain=False)
+    return res
 
 
 SYNTH_REC = "/tmp/mxnet_tpu_synth_imagenet.rec"
@@ -1615,18 +1698,24 @@ def main(argv=None):
         fa8_tps, fa8_mfu = f"unavailable: {type(e).__name__}", None
     _emit("flash_attention_seq8192", {"tokens_per_sec": fa8_tps,
                                       "mfu": fa8_mfu})
+    # int8 lane, un-parked (ISSUE 18): end-to-end quantized serving
+    # (bf16 vs int8 .mxa through ServingEngine) replaces the chip-gated
+    # XLA-conv measurement — weight-only serving runs on every backend
     try:
-        if CPU_SCALE:   # int8 MXU lane at resnet50 b32/224 — chip lane
-            raise _ChipOnly()
-        int8_ips = round(_gated("int8_inference", 120,
-                                _int8_inference_ips, sym), 2)
-    except _ChipOnly:
-        int8_ips = SKIP_CPU
+        int8_lane = _gated("int8_serving", 90, _quantized_serving_lane)
     except _BudgetExceeded:
-        int8_ips = "skipped: budget"
+        int8_lane = {"status": "skipped: budget"}
     except Exception as e:
-        int8_ips = f"unavailable: {type(e).__name__}"
-    _emit("int8_inference", {"b32_ips": int8_ips})
+        int8_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("int8_serving", int8_lane)
+    # continuous-batching decode at 1/8/32 concurrent sessions
+    try:
+        decode_lane = _gated("decode", 120, _decode_lane)
+    except _BudgetExceeded:
+        decode_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        decode_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("decode", decode_lane)
     try:
         if CPU_SCALE:   # 224px JPEG decode -> resnet50 b128 — chip lane
             raise _ChipOnly()
@@ -1811,10 +1900,13 @@ def main(argv=None):
         "inference_vs_baseline": round(infer_ips / K80_RN50_INFER_B32, 2),
         "inference_bf16_vs_baseline": round(
             infer16_ips / K80_RN50_INFER_B32, 2),
-        # int8 loses to bf16 on this chip BY MEASUREMENT — reported so
-        # the gap stays visible; parked with trace evidence in
-        # docs/int8_r04.md
-        "int8_inference_b32_ips": int8_ips,
+        # int8 lane un-parked as end-to-end quantized serving (bf16 vs
+        # int8 .mxa through ServingEngine; the old chip-gated XLA-conv
+        # story is history: docs/int8_r04.md)
+        "int8_serving": int8_lane,
+        # continuous-batching decode: tokens/s + per-token p50/p99 +
+        # kv occupancy at 1/8/32 concurrent sessions
+        "decode": decode_lane,
         # end-to-end lane: ImageRecordIter (native JPEG decode, uint8
         # payloads, on-device normalize) feeding the train step; on this
         # 1-core tunnel host it is transfer/decode-bound by measurement

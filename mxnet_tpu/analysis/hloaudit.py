@@ -50,7 +50,7 @@ ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8,
             "f8e4m3fn": 1, "f8e5m2": 1}
 
 PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "fit_step_zero",
-            "fit_step_embedding", "serving_bucket")
+            "fit_step_embedding", "serving_bucket", "fit_decode")
 
 # the cross-device data-movement ops the ZeRO lane audits. "-start"
 # suffixed async forms are matched alongside the synchronous spelling;
@@ -64,6 +64,7 @@ _PROGRAM_FILE = {
     "fit_step_zero": "parallel/zero.py",
     "fit_step_embedding": "parallel/embedding.py",
     "serving_bucket": "serving/engine.py",
+    "fit_decode": "serving/decode.py",
 }
 
 
@@ -479,6 +480,45 @@ def _audit_programs():
         "recompiles": int(eng.plan_compiles),
         "cost": _cost(plan),
     }
+
+    # fit_decode: the continuous-batching invariants (PR 18). ONE step
+    # executable regardless of session occupancy, KV-cache buffers
+    # donated between steps (steady-state decode holds one pool), and
+    # the calibrated int8 weights survive fusion as s8 dot operands.
+    from mxnet_tpu.serving.decode import DecodeEngine, DecodeModel
+    from mxnet_tpu.contrib.quantization import calibrate_weights
+    dmodel = DecodeModel(vocab=32, layers=2, d_model=32, heads=2,
+                         kv_heads=1, d_ff=64, max_len=32)
+    qparams, _ = calibrate_weights(dmodel.init_params(seed=3), "int8")
+    deng = DecodeEngine(dmodel, qparams, num_slots=4, warmup=True,
+                        name="audit-decode")
+    try:
+        # occupancy 1, then 3 concurrent: the plan must not re-key
+        deng.generate([1, 2, 3], max_new_tokens=4)
+        sess = [deng.submit([4 + i, 5], max_new_tokens=6)
+                for i in range(3)]
+        for s in sess:
+            s.result()
+        hlo = deng._step_plan.as_text()
+        donated = donated_param_indices(hlo)
+        out["programs"]["fit_decode"] = {
+            "allreduce_sync": hlo.count("all-reduce("),
+            "allreduce_async": hlo.count("all-reduce-start"),
+            "pairing_ok": allreduce_pairing_ok(hlo),
+            "has_f64": has_f64(hlo),
+            "convert_count": convert_count(hlo),
+            "donated": sorted(donated),
+            # one (K, V) cache buffer per layer, all donated
+            "donate_expected": 2 * dmodel.layers,
+            # occupancy changed 1 -> 3 across the run; a second
+            # executable here is the recompile storm the issue forbids
+            "recompiles": int(deng.step_compiles),
+            "int8_operands": "s8[" in hlo,
+            "step_executions": int(deng.step_executions),
+            "cost": _cost(deng._step_plan),
+        }
+    finally:
+        deng.close(drain=False)
     print(json.dumps(out), flush=True)
     return 0
 
@@ -577,6 +617,15 @@ def findings_from_report(rec, baseline=None):
                     f"{prog}: sparse exchange moves {w1} wire bytes "
                     f"vs the dense baseline's {wd} — the row-sparse "
                     f"path lost its reason to exist", scope=prog))
+        if prog == "fit_decode" and not r.get("int8_operands"):
+            # the quantized-matmul invariant: calibrated int8 weights
+            # must reach the fused dot as s8 operands — a convert back
+            # to f32 before fusion means the bandwidth win evaporated
+            findings.append(Finding(
+                "hlo-decode-no-int8-operands", "P1", file, 0,
+                f"{prog}: no s8 operands in the fused decode-step HLO — "
+                f"quantized weights are being dequantized outside the "
+                f"matmul fusion", scope=prog))
         if not r["pairing_ok"]:
             findings.append(Finding(
                 "hlo-allreduce-pairing", "P0", file, 0,

@@ -215,6 +215,20 @@ _DOCUMENTED = {
     "MXNET_SERVING_REPLICAS": 1,
     "MXNET_SERVING_HBM_BUDGET": None,
     "MXNET_SERVING_MAX_MODELS": 0,
+    # decode-mode serving (mxnet_tpu.serving.decode, docs/SERVING.md):
+    # _SLOTS is the KV-pool session capacity (one preallocated max_len
+    # cache block per slot; the decode step is compiled once for this
+    # width); _MAX_LEN is the default per-session cache length (prompt +
+    # generated tokens) when the model/artifact doesn't pin one;
+    # _MAX_NEW is the per-request generation budget when the request
+    # omits max_new_tokens
+    "MXNET_DECODE_SLOTS": 8,
+    "MXNET_DECODE_MAX_LEN": 256,
+    "MXNET_DECODE_MAX_NEW": 32,
+    # post-training weight quantization (contrib.quantization
+    # calibrate_weights / the export CLI): default target dtype for
+    # weight-only quantization — "int8" or "fp8" (float8_e4m3fn)
+    "MXNET_QUANT_DTYPE": "int8",
 }
 
 

@@ -44,8 +44,46 @@ def serving_buckets(max_batch):
     return buckets
 
 
+def _resolve_qdtype(quantize):
+    """True -> MXNET_QUANT_DTYPE, else the explicit 'int8'/'fp8'."""
+    from .. import config as _config
+    q = str(_config.get("MXNET_QUANT_DTYPE")) if quantize is True \
+        else str(quantize)
+    if q not in ("int8", "fp8"):
+        raise MXNetError(f"quantize: dtype must be int8 or fp8, got {q!r}")
+    return q
+
+
+def _pack_quantized(param_names, param_vals, qdtype, skip):
+    """Weight-only calibration over (names, vals): returns the packed
+    name/value lists with each quantized weight immediately followed by
+    its f32 ``{name}__scale`` companion, plus the manifest quant block.
+    fp8 tensors ride the container as uint8 byte views (the container
+    wire format predates fp8; the quant block says which to view back)."""
+    from .quantization import calibrate_weights
+    qparams, stats = calibrate_weights(
+        dict(zip(param_names, param_vals)), dtype=qdtype, skip=skip)
+    packed_names, packed_vals, qnames = [], [], []
+    for n in param_names:
+        v = qparams[n]
+        s = qparams.get(n + "__scale")
+        if s is not None:
+            qnames.append(n)
+            if qdtype == "fp8":
+                v = v.view(_np.uint8)
+        packed_names.append(n)
+        packed_vals.append(v)
+        if s is not None:
+            packed_names.append(n + "__scale")
+            packed_vals.append(s)
+    quant_meta = {"dtype": qdtype, "mode": "weight_only",
+                  "params": qnames, "stats": stats}
+    return packed_names, packed_vals, quant_meta
+
+
 def export_model(path, symbol, arg_params, aux_params, data_shapes,
-                 dtype="float32", platforms=None, model_name=None):
+                 dtype="float32", platforms=None, model_name=None,
+                 quantize=None, quantize_skip=()):
     """Serialize an inference-ready model to `path` (.mxa artifact).
 
     data_shapes: {input_name: shape} for every non-parameter argument
@@ -58,6 +96,17 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     additionally records the program's XLA cost/memory analytics under
     "devstats" (telemetry.devstats — FLOPs, arg/output/temp bytes, peak
     estimate), so capacity planning can read footprints offline.
+
+    quantize: "int8" | "fp8" | True (MXNET_QUANT_DTYPE) bakes
+    post-training weight-only quantization into the artifact: eligible
+    params (ndim >= 2, float, not in ``quantize_skip``) are stored
+    quantized with per-output-channel f32 ``{name}__scale`` companions
+    appended to ``param_names``, the manifest records a ``quant`` block
+    (dtype, per-channel scale ranges, calibration stats), and the
+    exported program dequantizes at the top — XLA fuses the
+    convert-and-scale into each consumer dot, so Predictor/ServingEngine
+    load quantized artifacts through the exact same code path as float
+    ones (params flow positionally by ``param_names``).
     """
     import jax
     import jax.numpy as jnp
@@ -100,8 +149,17 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
             param_vals.append(_np.zeros(inferred[n], _np.float32))
     aux_vals = [_np_of(aux_params[n]) for n in aux_names]
 
+    quant_meta = None
+    packed_names, packed_vals = param_names, param_vals
+    if quantize:
+        packed_names, packed_vals, quant_meta = _pack_quantized(
+            param_names, param_vals, _resolve_qdtype(quantize),
+            quantize_skip)
+    fp8_names = set(quant_meta["params"]) \
+        if quant_meta and quant_meta["dtype"] == "fp8" else set()
+
     run = _build_runner(symbol, is_train=False)
-    n_in, n_par = len(input_names), len(param_names)
+    n_in, n_par = len(input_names), len(packed_names)
     pos_of = {n: i for i, n in enumerate(arg_names)}
     bf16 = dtype == "bfloat16"
 
@@ -115,7 +173,19 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
             if bf16 and jnp.issubdtype(v.dtype, jnp.floating):
                 v = v.astype(jnp.bfloat16)
             args[pos_of[n]] = v
-        for n, v in zip(param_names, params):
+        pv = dict(zip(packed_names, params))
+        for n in param_names:
+            v = pv[n]
+            s = pv.get(n + "__scale")
+            if s is not None:
+                # weight-only dequant at the top of the program; XLA
+                # fuses the s8/f8->f32 convert and the per-channel scale
+                # into each consumer dot (hloaudit's int8-operand check)
+                if n in fp8_names:
+                    import jax.lax as lax
+                    v = lax.bitcast_convert_type(
+                        v, jnp.float8_e4m3fn)
+                v = v.astype(jnp.float32) * s
             if bf16 and v.ndim > 1 and \
                     jnp.issubdtype(v.dtype, jnp.floating):
                 v = v.astype(jnp.bfloat16)
@@ -128,7 +198,7 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     in_specs = [jax.ShapeDtypeStruct(tuple(data_shapes[n]), jnp.float32)
                 for n in input_names]
     par_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype)
-                 for v in param_vals]
+                 for v in packed_vals]
     aux_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in aux_vals]
     rng_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)   # raw PRNG key
 
@@ -185,7 +255,7 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
         "model_name": str(model_name),
         "inputs": [{"name": n, "shape": list(data_shapes[n]),
                     "dtype": "float32"} for n in input_names],
-        "param_names": param_names,
+        "param_names": packed_names,
         "aux_names": aux_names,
         "outputs": symbol.list_outputs(),
         "dtype": dtype,
@@ -193,6 +263,8 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     }
     if serving_meta is not None:
         manifest["serving"] = serving_meta
+    if quant_meta is not None:
+        manifest["quant"] = quant_meta
     # export-funnel devstats: one AOT compile of the inference program
     # for its cost/memory analytics — export is offline, the extra
     # compile is fine, and the manifest gets the per-program footprint
@@ -213,12 +285,77 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     with tempfile.TemporaryDirectory() as td:
         pfile = os.path.join(td, PARAMS_FILE)
         # container.save_container takes raw numpy directly
-        save = {f"arg:{n}": v for n, v in zip(param_names, param_vals)}
+        save = {f"arg:{n}": v for n, v in zip(packed_names, packed_vals)}
         save.update({f"aux:{n}": v
                      for n, v in zip(aux_names, aux_vals)})
         container.save_container(pfile, save)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr(MANIFEST, json.dumps(manifest, indent=1))
             zf.writestr(MODULE_FILE, exp.serialize())
+            zf.write(pfile, PARAMS_FILE)
+    return path
+
+
+def export_decode_model(path, decode_config, params, model_name=None,
+                        quantize=None, quantize_skip=("embed", "pos")):
+    """Serialize a decode (autoregressive) model to a `.mxa` artifact.
+
+    Unlike `export_model` there is NO StableHLO module: decode plans are
+    shape-parametric in runtime knobs (KV-pool slot count, prompt
+    buckets), so `serving.decode.DecodeEngine` AOT-compiles them at load
+    from the manifest's ``decode`` block (DecodeModel architecture
+    config) + the params container. The manifest's ``devstats`` block
+    carries a peak-bytes estimate (weights + the default-slot-count KV
+    pool) so ModelRouter admission can preflight the artifact unopened,
+    and ``quantize=`` bakes weight-only int8/fp8 params + per-channel
+    scales exactly like `export_model` (same ``quant`` block; the decode
+    engine's matmuls pick up ``{name}__scale`` companions natively).
+    """
+    from .. import config as _config
+    from ..ndarray import container
+    from ..serving.decode import DecodeModel
+    import os
+    import tempfile
+
+    model = DecodeModel.from_config(dict(decode_config))
+    names = model.param_names()
+    missing = [n for n in names if n not in params]
+    if missing:
+        raise MXNetError(f"export_decode_model: missing params {missing}")
+    param_vals = [_np.ascontiguousarray(
+        _np.asarray(params[n]).astype(_np.float32)
+        if _np.asarray(params[n]).dtype == _np.float64
+        else _np.asarray(params[n])) for n in names]
+
+    quant_meta = None
+    packed_names, packed_vals = names, param_vals
+    if quantize:
+        packed_names, packed_vals, quant_meta = _pack_quantized(
+            names, param_vals, _resolve_qdtype(quantize), quantize_skip)
+
+    if model_name is None:
+        model_name = os.path.splitext(os.path.basename(str(path)))[0] \
+            or "model"
+    params_bytes = sum(int(v.nbytes) for v in packed_vals)
+    pool_bytes = int(_config.get("MXNET_DECODE_SLOTS")) \
+        * model.session_cache_bytes()
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "model_name": str(model_name),
+        "decode": dict(model.config(), param_names=list(packed_names)),
+        # router admission preflight reads peak_bytes before loading:
+        # resident weights + the KV pool at the default slot count
+        "devstats": {"params_bytes": params_bytes,
+                     "peak_bytes": params_bytes + pool_bytes},
+    }
+    if quant_meta is not None:
+        manifest["quant"] = quant_meta
+    with tempfile.TemporaryDirectory() as td:
+        pfile = os.path.join(td, PARAMS_FILE)
+        container.save_container(
+            pfile, {f"arg:{n}": v
+                    for n, v in zip(packed_names, packed_vals)})
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(MANIFEST, json.dumps(manifest, indent=1))
             zf.write(pfile, PARAMS_FILE)
     return path

@@ -22,7 +22,8 @@ from ..base import MXNetError
 from ..ops.registry import get_op
 from ..symbol.symbol import Symbol, _Node
 
-__all__ = ["quantize_model"]
+__all__ = ["quantize_model", "calibrate_weights",
+           "quantize_decode_artifact"]
 
 # fp32 op -> quantized twin (quantize_graph_pass.cc FQuantizedOp registry)
 _QUANTIZED_OP_MAP = {
@@ -374,3 +375,109 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     qarg_params = _quantize_params(qsym, arg_params)
     return qsym, qarg_params, aux_params
+
+
+# -- post-training weight-only calibration (export / decode serving) --------
+#
+# The graph rewrite above quantizes ACTIVATIONS through _contrib_quantized_*
+# twins; the export/serving path instead wants weight-only quantization:
+# per-output-channel symmetric int8/fp8 weights + f32 scale vectors baked
+# into the .mxa artifact, consumed by the fused quantized matmul
+# (ops/quantization.quantized_matmul — dequant inside the kernel). The fp8
+# lane reuses the ZeRO wire-compression dtype choice (parallel/zero.py
+# _COMPRESS_DTYPES: float8_e4m3fn keeps the most mantissa of the fp8
+# encodings), applied per-channel instead of per-tensor.
+
+def calibrate_weights(params, dtype=None, skip=("embed", "pos"),
+                      min_ndim=2):
+    """Weight-only post-training calibration over a {name: array} dict.
+
+    Every float param with ndim >= ``min_ndim`` whose name (or last
+    dot-component) is not in ``skip`` is replaced by its quantized twin
+    plus an f32 ``{name}__scale`` companion (per-output-channel symmetric
+    scales, ops/quantization.quantize_rows). ``skip`` defaults to lookup
+    tables — embeddings/positions are gathered, not matmul'd, so the
+    fused-dequant matmul never sees them. dtype defaults to
+    MXNET_QUANT_DTYPE ("int8" | "fp8").
+
+    Returns (qparams, stats): stats maps each quantized name to its
+    calibration record — per-channel |w| max, the scale range, and the
+    RMS relative dequantization error (the number docs/int8_r04.md was
+    missing when the bench lane was parked).
+    """
+    from .. import config as _config
+    from ..ops.quantization import dequantize_rows, quantize_rows
+
+    dtype = dtype or str(_config.get("MXNET_QUANT_DTYPE"))
+    skip = set(skip or ())
+    out, stats = {}, {}
+    for name, w in params.items():
+        w = np.asarray(w)
+        leaf = name.rsplit(".", 1)[-1]
+        if (w.ndim < min_ndim or not np.issubdtype(w.dtype, np.floating)
+                or name in skip or leaf in skip):
+            out[name] = w
+            continue
+        q, s = quantize_rows(w.astype(np.float32), dtype)
+        q, s = np.asarray(q), np.asarray(s)
+        deq = np.asarray(dequantize_rows(q, s))
+        denom = float(np.sqrt(np.mean(np.square(w))) or 1.0)
+        err = float(np.sqrt(np.mean(np.square(deq - w)))) / denom
+        out[name] = q
+        out[name + "__scale"] = s
+        stats[name] = {"shape": list(w.shape),
+                       "amax": float(np.max(np.abs(w))),
+                       "scale_min": float(np.min(s)),
+                       "scale_max": float(np.max(s)),
+                       "rms_rel_err": err}
+    if not stats:
+        raise MXNetError("calibrate_weights: nothing to quantize "
+                         f"(params={list(params)!r}, skip={sorted(skip)})")
+    return out, stats
+
+
+def quantize_decode_artifact(src, dst, dtype=None, skip=("embed", "pos")):
+    """Calibration CLI core: load a float decode ``.mxa`` (see
+    contrib.export.export_decode_model), bake weight-only int8/fp8
+    params + scales into a new artifact at ``dst``. Returns the stats
+    dict that also lands in the manifest ``quant`` block."""
+    from ..serving.decode import _load_decode_artifact
+    from .export import export_decode_model
+
+    cfg, params, name, quant = _load_decode_artifact(str(src))
+    if quant:
+        raise MXNetError(f"{src}: already quantized ({quant.get('dtype')})")
+    export_decode_model(dst, cfg, params, model_name=name,
+                        quantize=dtype or True, quantize_skip=skip)
+    from ..serving.decode import _load_decode_artifact as _reload
+    return _reload(str(dst))[3]
+
+
+def main(argv=None):
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.contrib.quantization",
+        description="post-training weight-only calibration: float decode "
+                    ".mxa -> int8/fp8 .mxa with per-channel scales in the "
+                    "manifest")
+    ap.add_argument("src", help="float decode .mxa artifact")
+    ap.add_argument("dst", help="output quantized .mxa path")
+    ap.add_argument("--dtype", default=None, choices=("int8", "fp8"),
+                    help="target dtype (default: MXNET_QUANT_DTYPE)")
+    ap.add_argument("--skip", default="embed,pos",
+                    help="comma-separated param names (or last "
+                         "dot-components) to keep float")
+    args = ap.parse_args(argv)
+    skip = tuple(s for s in args.skip.split(",") if s)
+    quant = quantize_decode_artifact(args.src, args.dst,
+                                     dtype=args.dtype, skip=skip)
+    print(json.dumps({"metric": "quantize_decode_artifact",
+                      "dst": args.dst, "dtype": quant["dtype"],
+                      "params": len(quant["params"]), "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -577,6 +577,156 @@ def flash_attention(q, k, v, causal=False, scale=None, force=None,
     return reference_attention(q, k, v, causal, scale)
 
 
+# -- decode mode (q_len = 1 against a KV cache) -----------------------------
+
+def reference_decode_attention(q, k, v, lengths, scale=None):
+    """Dense decode-step oracle. q (B, H, D) is the current token's
+    query; k/v (B, H_kv, S, D) are KV caches of which only the first
+    ``lengths[b]`` positions are valid (the rest is stale pool memory and
+    MUST NOT leak into the softmax). Returns (B, H, D). Rows with
+    lengths == 0 produce zeros (the empty-softmax convention shared with
+    reference_attention_with_lse)."""
+    b, h, d = q.shape
+    h_kv, s = k.shape[1], k.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if h_kv != h:
+        group = h // h_kv
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s), 2)
+    valid = pos < jnp.asarray(lengths, jnp.int32).reshape(b, 1, 1)
+    scores = jnp.where(valid, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)
+    safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(scores - safe[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = jnp.einsum("bhs,bhsd->bhd", p,
+                     v.astype(jnp.float32)) / l_safe[..., None]
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_k,
+                   seq_len, scale):
+    """One kv-head grid cell of the decode step: the q "rows" are the
+    GQA group sharing this kv head (the q_len=1 realization of the
+    forward kernel's (q-block, kv-stream) structure — the group axis
+    stands in for the q-block so the MXU still sees a matmul). K/V
+    stream in blocks with the online softmax; positions >= the session's
+    length are masked (stale pool memory beyond the write cursor)."""
+    import jax.experimental.pallas as pl
+
+    q = q_ref[:]                                        # (G, D)
+    l = len_ref[0, 0]                                   # valid kv length
+    g = q.shape[0]
+    acc0 = jnp.zeros((g, q.shape[1]), jnp.float32)
+    m0 = jnp.full((g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    # dynamic block bound: blocks wholly past the write cursor contribute
+    # nothing — the decode cost scales with the session's length, not the
+    # pool's max_len
+    n_blocks = jnp.minimum(seq_len // block_k,
+                           (l + block_k - 1) // block_k)
+
+    def body(i, carry):
+        acc, m, lsum = carry
+        start = i * block_k
+        k_blk = k_ref[pl.dslice(start, block_k), :]
+        v_blk = v_ref[pl.dslice(start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (G, Bk)
+        k_pos = start + jax.lax.broadcasted_iota(jnp.int32,
+                                                 (1, block_k), 1)
+        s = jnp.where(k_pos < l, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+        l_new = lsum * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, _, lsum = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    l_safe = jnp.where(lsum == 0, 1.0, lsum)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, lengths, scale, interpret=False):
+    import jax.experimental.pallas as pl
+
+    b, h, d = q.shape
+    h_kv, s = k.shape[1], k.shape[2]
+    group = h // h_kv
+    block_k = min(_auto_block(s), s)
+    qf = q.reshape(b * h_kv, group, d)
+    kf = k.reshape(b * h_kv, s, d)
+    vf = v.reshape(b * h_kv, s, d)
+    lens = jnp.asarray(lengths, jnp.int32).reshape(b, 1, 1)
+    kernel = functools.partial(_decode_kernel, block_k=block_k,
+                               seq_len=s, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h_kv,),
+        in_specs=[
+            pl.BlockSpec((None, group, d), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, 1), lambda bh: (bh // h_kv, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, group, d), lambda bh: (bh, 0, 0)),
+        out_shape=_sds((b * h_kv, group, d), q.dtype, q),
+        interpret=interpret,
+        **_vmem_params(s, d, 2, interpret, q.dtype.itemsize),
+    )(qf, kf, vf, lens)
+    return out.reshape(b, h, d)
+
+
+def _decode_eligible(q, k, platform=None):
+    b, h, d = q.shape
+    if k.shape[0] != b or k.shape[3] != d or k.shape[1] == 0 \
+            or h % k.shape[1] != 0:
+        return False
+    s = k.shape[2]
+    if d % 128 != 0 and d not in (64,):
+        return False
+    if s % min(_auto_block(s), s) != 0 or s < 8:
+        return False
+    if platform is not None:
+        return platform == "tpu"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def decode_attention(q, k, v, lengths, scale=None, force=None,
+                     platform=None):
+    """Single-token decode attention against a length-masked KV cache.
+
+    q (B, H, D); k/v (B, H_kv, S, D) pool blocks; lengths (B,) int32
+    valid-prefix lengths. GQA shares kv in-kernel exactly like
+    flash_attention (the kv-head grid cell serves its whole q group).
+    force: None (auto: Pallas on TPU-eligible shapes) | 'pallas' |
+    'xla' | 'interpret'."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if force == "xla":
+        return reference_decode_attention(q, k, v, lengths, scale)
+    if force in ("pallas", "interpret") or \
+            (force is None and _decode_eligible(q, k, platform)):
+        return _decode_pallas(q, k, v, lengths, scale,
+                              interpret=force == "interpret")
+    return reference_decode_attention(q, k, v, lengths, scale)
+
+
 # -- registry surface -------------------------------------------------------
 
 def _flash_attention_op(attrs, octx, q, k, v):

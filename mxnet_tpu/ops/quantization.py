@@ -343,3 +343,156 @@ register("_contrib_quantized_flatten", _quantized_flatten,
          infer_shape=_qflatten_infer,
          infer_type=lambda attrs, in_types: [in_types[0], "float32",
                                              "float32"])
+
+
+# -- weight-only quantization (decode/serving bandwidth path) ---------------
+#
+# The ops above mirror the reference's activation+weight int8 graph rewrite
+# (int8 x int8 -> int32 on the MXU). Decode serving wants something simpler
+# and strictly bandwidth-motivated: weights stored narrow (int8 / fp8
+# e4m3), activations left in bf16/fp32, dequant fused INTO the matmul so
+# the wide weight tensor never exists in HBM. Per-OUTPUT-channel symmetric
+# scales keep the error per channel; because the scale is constant along
+# the contraction axis it factors out of the dot —
+#     x @ (q * s[None, :]) == (x @ q_wide) * s
+# — which is exactly the algebra both consumers below rely on.
+
+_WEIGHT_QDTYPES = ("int8", "fp8")
+
+
+def _fp8_dtype():
+    """float8_e4m3fn when this jax build has it (e4m3: decode wants the
+    mantissa, matching parallel/zero.py's wire-dtype choice); None
+    disables the fp8 lane rather than silently aliasing to bf16 — a
+    "quantized" artifact must actually be narrow."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def quantize_rows(w, dtype="int8"):
+    """Per-output-channel symmetric weight quantization.
+
+    w: (..., K, N) float array; the LAST axis is the output-feature axis.
+    Returns (q, scale): q is int8 (or fp8 e4m3) with the same shape,
+    scale is (N,) float32 with w ~= q.astype(f32) * scale. Channels that
+    are entirely zero get scale 1.0 (q is zero there either way).
+    """
+    w = _np.asarray(w, _np.float32)
+    if w.ndim < 2:
+        raise MXNetError("quantize_rows: need a matrix (ndim >= 2), got "
+                         f"shape {w.shape}")
+    amax = _np.max(_np.abs(w), axis=tuple(range(w.ndim - 1)))
+    if dtype == "int8":
+        scale = _np.where(amax > 0, amax / 127.0, 1.0).astype(_np.float32)
+        q = _np.clip(_np.rint(w / scale), -127, 127).astype(_np.int8)
+    elif dtype == "fp8":
+        f8 = _fp8_dtype()
+        if f8 is None:
+            raise MXNetError("quantize_rows: this jax build has no "
+                             "float8_e4m3fn — use dtype='int8'")
+        # e4m3fn max finite value is 448
+        scale = _np.where(amax > 0, amax / 448.0, 1.0).astype(_np.float32)
+        q = _np.asarray(jnp.asarray(w / scale).astype(f8))
+    else:
+        raise MXNetError(f"quantize_rows: dtype must be one of "
+                         f"{_WEIGHT_QDTYPES}, got {dtype!r}")
+    return q, scale
+
+
+def dequantize_rows(q, scale):
+    """Inverse of quantize_rows (the oracle the fused matmul is tested
+    against): wide float32 weights."""
+    return _np.asarray(q, _np.float32) * _np.asarray(scale, _np.float32)
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, block_k, k_dim):
+    """One (m-block, n-block) grid cell of the fused quantized matmul:
+    stream K-blocks of the NARROW weight, widen in VMEM, MXU dot with
+    fp32 accumulation, one per-channel scale multiply at the end (the
+    scale factors out of the contraction)."""
+    acc0 = jnp.zeros((x_ref.shape[0], o_ref.shape[1]), jnp.float32)
+    n_blocks = k_dim // block_k
+
+    def body(i, acc):
+        import jax.experimental.pallas as pl
+        xk = x_ref[:, pl.dslice(i * block_k, block_k)]
+        qk = q_ref[pl.dslice(i * block_k, block_k), :]
+        return acc + jax.lax.dot_general(
+            xk, qk.astype(xk.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    acc = jax.lax.fori_loop(0, n_blocks, body, acc0)
+    o_ref[:] = (acc * s_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _qmm_block(dim, prefs=(256, 128, 8)):
+    for blk in prefs:
+        if dim % blk == 0:
+            return blk
+    return dim
+
+
+def _qmm_pallas(x, q, scale, interpret=False):
+    import functools
+    import jax.experimental.pallas as pl
+    m, k = x.shape
+    n = q.shape[1]
+    block_m = _qmm_block(m)
+    block_n = _qmm_block(n, (512, 256, 128))
+    block_k = _qmm_block(k, (512, 256, 128))
+    kernel = functools.partial(_qmm_kernel, block_k=block_k, k_dim=k)
+    return pl.pallas_call(
+        kernel,
+        grid=(m // block_m, n // block_n),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda mi, ni: (mi, 0)),
+            pl.BlockSpec((k, block_n), lambda mi, ni: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda mi, ni: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda mi, ni: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, n))
+
+
+def _qmm_eligible(x, q, platform=None):
+    if x.ndim != 2 or q.ndim != 2:
+        return False
+    m, k = x.shape
+    n = q.shape[1]
+    if k % 128 or n % 128:
+        return False
+    if platform is not None:
+        return platform == "tpu"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def quantized_matmul(x, q, scale, force=None, platform=None):
+    """x @ dequant(q, scale) without materializing the wide weight.
+
+    x: (..., K) activations (bf16/f32); q: (K, N) int8 or fp8 weights;
+    scale: (N,) per-output-channel float32. On TPU (tile-friendly K/N)
+    a Pallas kernel widens weight blocks in VMEM and fuses the scale
+    into the epilogue; elsewhere the XLA spelling
+    ``dot(x, q.astype(x.dtype)) * scale`` is used — XLA fuses the
+    narrow->wide convert into the dot fusion, so the HLO still reads the
+    s8/f8 buffer (hloaudit's fit_decode audit pins this).
+
+    force: None (auto) | 'pallas' | 'xla' | 'interpret'.
+    """
+    if q.ndim != 2 or x.shape[-1] != q.shape[0]:
+        raise MXNetError(f"quantized_matmul: x {x.shape} @ q {q.shape}")
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, x.shape[-1]))
+    use_pallas = (force in ("pallas", "interpret") or
+                  (force is None and _qmm_eligible(x2, q, platform)))
+    if use_pallas:
+        out = _qmm_pallas(x2, q, scale, interpret=force == "interpret")
+    else:
+        out = jax.lax.dot_general(
+            x2, q.astype(x.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = (out * scale.astype(jnp.float32)).astype(x.dtype)
+    return out.reshape(lead + (q.shape[1],))

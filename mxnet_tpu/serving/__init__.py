@@ -20,8 +20,13 @@ Composition (each piece is independently usable):
                            measured plan_resident_bytes.
     frontend.ServingFrontend
                            the network tier: stdlib HTTP/1.1 JSON front
-                           door (predict/load/unload//metrics) over a
-                           ModelRouter — docs/SERVING.md "Network tier".
+                           door (predict/load/unload/generate//metrics)
+                           over a ModelRouter — docs/SERVING.md
+                           "Network tier".
+    decode.DecodeEngine    autoregressive decode runtime: per-session
+                           KV-cache pool, prefill buckets, ONE compiled
+                           decode-step plan continuous-batching every
+                           live session (docs/SERVING.md "Decode").
 
 Quick start:
 
@@ -52,16 +57,24 @@ from .pool import EnginePool
 from .router import ModelRouter, UnknownModel
 
 
+_LAZY = {"ServingFrontend": "frontend", "DecodeEngine": "decode",
+         "DecodeModel": "decode", "SessionPool": "decode",
+         "SessionPoolFull": "decode", "Session": "decode"}
+
+
 def __getattr__(name):
-    # lazy: `python -m mxnet_tpu.serving.frontend` would otherwise see
-    # frontend in sys.modules before runpy executes it (RuntimeWarning)
-    if name == "ServingFrontend":
-        from .frontend import ServingFrontend
-        return ServingFrontend
+    # lazy: `python -m mxnet_tpu.serving.frontend` (or .decode) would
+    # otherwise see the submodule in sys.modules before runpy executes
+    # it (RuntimeWarning)
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = ["ServingEngine", "DynamicBatcher", "ServingMetrics",
            "Future", "RequestTimeout", "ServingQueueFull",
            "ADMISSION_CLASSES", "EnginePool", "ModelRouter",
-           "UnknownModel", "ServingFrontend"]
+           "UnknownModel", "ServingFrontend", "DecodeEngine",
+           "DecodeModel", "SessionPool", "SessionPoolFull", "Session"]

@@ -1,0 +1,838 @@
+"""Decode-mode serving: per-session KV-cache pool + continuous batching.
+
+One-shot ``predict`` (serving/engine.py) re-runs the whole context per
+request; autoregressive generation needs the opposite shape — a prompt is
+*prefilled* once into a per-session KV cache, then a tiny fixed-shape
+*decode step* (q_len = 1) advances every live session one token per
+dispatch. Two invariants drive the design:
+
+* **One executable, any occupancy.** The decode step is compiled exactly
+  once, for the full slot count. Sessions join at prefill-completion and
+  leave at EOS/max_len by flipping a per-slot ``active`` mask — shapes
+  never change, so occupancy changes never recompile (the hloaudit
+  ``fit_decode`` recompile-storm check binds on this). All per-slot math
+  is row-independent (masked writes, per-row attention, per-row argmax),
+  so a session's token stream is bit-identical whether it runs alone or
+  packed with seven neighbours — the selftest asserts this.
+* **Caches are pool memory, sized up front.** The KV pool
+  (layers x {K,V} x num_slots x kv_heads x max_len x head_dim) is
+  allocated once and preflighted against the devstats HBM budget
+  (telemetry/devstats.py, PR 14): a pool that cannot fit fails at
+  construction with a sized ``HBMPreflightError`` instead of OOMing
+  mid-request, and a submit that cannot get a block (slots + wait queue
+  exhausted) raises :class:`SessionPoolFull` — the frontend maps both to
+  HTTP 507. Cache buffers are donated between steps
+  (``donate_argnums``), so steady-state decode holds ONE pool, not two.
+
+Prefill reuses the serving tier's power-of-two bucket ladder (one
+compiled prefill plan per prompt bucket; slot index and true length are
+traced scalars, so neither re-keys the plan) and writes straight into
+the session's pool block. Attention is ``ops.attention``: causal flash
+attention for prefill, the decode-mode (q_len = 1) kernel for steps.
+Weights with a ``{name}__scale`` companion (weight-only int8/fp8 from
+contrib/quantization.py) are consumed through ``ops.quantization.
+quantized_matmul`` — dequant fused into the matmul, halving the weight
+bytes each decode step streams.
+
+``python -m mxnet_tpu.serving.decode --selftest`` generates with 8
+concurrent staggered sessions on a few-layer GQA transformer and
+asserts the streams are bit-identical to sequential per-session decode
+at strictly higher aggregate tokens/s.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zipfile
+from collections import deque
+
+import numpy as np
+
+from .. import config as _config
+from ..base import MXNetError
+from ..telemetry import devstats
+from .batcher import Future
+
+__all__ = ["DecodeModel", "DecodeEngine", "Session", "SessionPool",
+           "SessionPoolFull", "prompt_buckets"]
+
+# Reviewed single-writer surfaces (locklint): the engine's loop thread is
+# the ONLY writer of the device state (_k/_v, per-slot token/length
+# vectors, the lazily-built plans) and of the perf counters after
+# __init__'s warmup (which happens-before the thread starts). Caller
+# threads only read them — stats() tolerates stale-by-one counter reads.
+# Pool/queue state, by contrast, IS lock-guarded: every SessionPool call
+# sits under DecodeEngine._cv.
+__analysis_thread_safe__ = {
+    "DecodeEngine._k", "DecodeEngine._v", "DecodeEngine._tokens",
+    "DecodeEngine._lengths", "DecodeEngine._active",
+    "DecodeEngine._step_plan", "DecodeEngine.step_compiles",
+    "DecodeEngine.plan_compiles", "DecodeEngine.plan_resident_bytes",
+    "DecodeEngine.step_executions", "DecodeEngine.prefill_executions",
+    "DecodeEngine.tokens_generated", "DecodeEngine.sessions_done",
+}
+
+
+def _int_knob(name):
+    v = _config.get(name)
+    return int(v) if v is not None else None
+
+
+def prompt_buckets(max_len, lo=8):
+    """Power-of-two prompt-bucket ladder: lo, 2*lo, ... capped at (and
+    always including) max_len — the serving-tier ladder, applied to the
+    sequence axis instead of the batch axis."""
+    if max_len < 1:
+        raise MXNetError("prompt_buckets: max_len must be >= 1")
+    buckets, b = [], lo
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_len))
+    return buckets
+
+
+# -- model ------------------------------------------------------------------
+
+class DecodeModel:
+    """Functional GQA transformer LM over a flat {name: array} param dict.
+
+    Pre-norm blocks (RMSNorm), learned positions, gelu MLP. Every linear
+    goes through :meth:`_mm`, which transparently uses the fused
+    quantized matmul when the param carries a ``{name}__scale``
+    companion — the float and quantized artifacts share one code path.
+
+    Two entry points, both pure (jit/AOT-friendly):
+
+    * :meth:`prefill` — full-sequence causal pass over one padded prompt
+      bucket; writes K/V for positions [0, bucket) into one slot of the
+      cache and returns the first generated token.
+    * :meth:`step` — one decode step for ALL slots at once (q_len = 1
+      against the cache); inactive slots are masked inert so the same
+      executable serves any occupancy.
+    """
+
+    def __init__(self, vocab, layers=2, d_model=64, heads=4, kv_heads=None,
+                 d_ff=None, max_len=None, attention=None, matmul=None):
+        kv_heads = int(kv_heads) if kv_heads else int(heads)
+        if heads % kv_heads:
+            raise MXNetError("DecodeModel: heads %% kv_heads != 0")
+        if d_model % heads:
+            raise MXNetError("DecodeModel: d_model %% heads != 0")
+        self.vocab = int(vocab)
+        self.layers = int(layers)
+        self.d_model = int(d_model)
+        self.heads = int(heads)
+        self.kv_heads = kv_heads
+        self.d_ff = int(d_ff) if d_ff else 4 * self.d_model
+        self.max_len = int(max_len) if max_len \
+            else _int_knob("MXNET_DECODE_MAX_LEN")
+        self.head_dim = self.d_model // self.heads
+        self.attention = attention       # force arg for ops.attention
+        self.matmul = matmul             # force arg for quantized_matmul
+
+    def config(self):
+        """Manifest-serializable architecture block."""
+        return {"vocab": self.vocab, "layers": self.layers,
+                "d_model": self.d_model, "heads": self.heads,
+                "kv_heads": self.kv_heads, "d_ff": self.d_ff,
+                "max_len": self.max_len}
+
+    @classmethod
+    def from_config(cls, cfg, **kw):
+        return cls(vocab=cfg["vocab"], layers=cfg["layers"],
+                   d_model=cfg["d_model"], heads=cfg["heads"],
+                   kv_heads=cfg["kv_heads"], d_ff=cfg["d_ff"],
+                   max_len=cfg["max_len"], **kw)
+
+    def param_names(self):
+        names = ["embed", "pos"]
+        for i in range(self.layers):
+            names += [f"l{i}.ln1", f"l{i}.wq", f"l{i}.wk", f"l{i}.wv",
+                      f"l{i}.wo", f"l{i}.ln2", f"l{i}.w1", f"l{i}.w2"]
+        names += ["lnf", "head"]
+        return names
+
+    def init_params(self, seed=0):
+        rng = np.random.RandomState(seed)
+        d, h, hkv, hd = self.d_model, self.heads, self.kv_heads, \
+            self.head_dim
+
+        def w(*shape):
+            return (rng.standard_normal(shape)
+                    / np.sqrt(shape[0])).astype(np.float32)
+
+        p = {"embed": w(self.vocab, d), "pos": 0.1 * w(self.max_len, d),
+             "lnf": np.ones(d, np.float32), "head": w(d, self.vocab)}
+        for i in range(self.layers):
+            p[f"l{i}.ln1"] = np.ones(d, np.float32)
+            p[f"l{i}.wq"] = w(d, h * hd)
+            p[f"l{i}.wk"] = w(d, hkv * hd)
+            p[f"l{i}.wv"] = w(d, hkv * hd)
+            p[f"l{i}.wo"] = w(h * hd, d)
+            p[f"l{i}.ln2"] = np.ones(d, np.float32)
+            p[f"l{i}.w1"] = w(d, self.d_ff)
+            p[f"l{i}.w2"] = w(self.d_ff, d)
+        return p
+
+    def session_cache_bytes(self, dtype_size=4):
+        """Per-session KV block: layers x {K,V} x kv_heads x max_len x
+        head_dim — the unit the pool admission math is denominated in."""
+        return (self.layers * 2 * self.kv_heads * self.max_len
+                * self.head_dim * dtype_size)
+
+    def init_cache(self, num_slots):
+        """(kc, vc): per-layer tuples of (num_slots, kv_heads, max_len,
+        head_dim) f32 — tuples (not one stacked array) so layer writes
+        never materialize a whole-pool copy and donation aliases every
+        leaf independently."""
+        import jax.numpy as jnp
+        shape = (num_slots, self.kv_heads, self.max_len, self.head_dim)
+        kc = tuple(jnp.zeros(shape, jnp.float32)
+                   for _ in range(self.layers))
+        vc = tuple(jnp.zeros(shape, jnp.float32)
+                   for _ in range(self.layers))
+        return kc, vc
+
+    # -- building blocks ----------------------------------------------------
+
+    def _mm(self, params, name, x):
+        import jax
+        import jax.numpy as jnp
+        w = params[name]
+        s = params.get(name + "__scale")
+        if s is not None:
+            from ..ops.quantization import quantized_matmul
+            return quantized_matmul(x, w, s, force=self.matmul)
+        return jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+
+    @staticmethod
+    def _norm(x, g):
+        import jax
+        import jax.numpy as jnp
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
+
+    # -- prefill ------------------------------------------------------------
+
+    def prefill(self, params, kc, vc, tokens, true_len, slot):
+        """One prompt into one pool slot. tokens (1, S_b) int32 padded to
+        its bucket; ``true_len`` / ``slot`` are TRACED int32 scalars (no
+        per-slot or per-length recompile). Positions >= true_len are pad:
+        causal masking keeps them out of every valid row's softmax, and
+        the decode step's length mask keeps their cached K/V dead.
+        Returns (kc, vc, first_token, last_logits)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.attention import flash_attention
+
+        s_b = tokens.shape[1]
+        h, hkv, hd = self.heads, self.kv_heads, self.head_dim
+        x = params["embed"][tokens] + params["pos"][None, :s_b]
+        for i in range(self.layers):
+            pfx = f"l{i}."
+            hn = self._norm(x, params[pfx + "ln1"])
+            q = self._mm(params, pfx + "wq", hn) \
+                .reshape(1, s_b, h, hd).transpose(0, 2, 1, 3)
+            k = self._mm(params, pfx + "wk", hn) \
+                .reshape(1, s_b, hkv, hd).transpose(0, 2, 1, 3)
+            v = self._mm(params, pfx + "wv", hn) \
+                .reshape(1, s_b, hkv, hd).transpose(0, 2, 1, 3)
+            a = flash_attention(q, k, v, causal=True, force=self.attention)
+            x = x + self._mm(params, pfx + "wo",
+                             a.transpose(0, 2, 1, 3).reshape(1, s_b,
+                                                             h * hd))
+            hn2 = self._norm(x, params[pfx + "ln2"])
+            x = x + self._mm(params, pfx + "w2",
+                             jax.nn.gelu(self._mm(params, pfx + "w1",
+                                                  hn2)))
+            kc = kc[:i] + (jax.lax.dynamic_update_slice(
+                kc[i], k, (slot, 0, 0, 0)),) + kc[i + 1:]
+            vc = vc[:i] + (jax.lax.dynamic_update_slice(
+                vc[i], v, (slot, 0, 0, 0)),) + vc[i + 1:]
+        # logits for the LAST VALID position only — slice before the head
+        # matmul so the vocab projection runs on one row, not the bucket
+        xlast = jax.lax.dynamic_slice(
+            x[0], (true_len - 1, 0), (1, self.d_model))
+        logits = self._mm(params, "head", self._norm(xlast, params["lnf"]))
+        tok0 = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+        return kc, vc, tok0, logits[0]
+
+    # -- decode step --------------------------------------------------------
+
+    def step(self, params, kc, vc, tokens, lengths, active):
+        """Advance every slot one token. tokens/lengths (N,) int32,
+        active (N,) bool. Writes each row's K/V at position lengths[n],
+        attends over lengths[n]+1 cached positions, emits the greedy
+        next token. Inactive rows are inert: their token/length pass
+        through unchanged and their (garbage) cache writes land in their
+        own retired block, which the next prefill overwrites before any
+        read. Every op is row-independent, so a slot's stream does not
+        depend on who else is resident — the bit-identity the selftest
+        checks. Returns (kc, vc, next_tokens, new_lengths, logits)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.attention import decode_attention
+
+        n = tokens.shape[0]
+        h, hkv, hd = self.heads, self.kv_heads, self.head_dim
+        pos = jnp.clip(lengths, 0, self.max_len - 1)
+        att_len = jnp.minimum(pos + 1, self.max_len)
+        x = params["embed"][tokens] + params["pos"][pos]
+
+        def write_row(row_cache, new_row, p):
+            # (hkv, S, hd) <- (hkv, hd) at position p
+            return jax.lax.dynamic_update_slice(
+                row_cache, new_row[:, None, :], (0, p, 0))
+
+        for i in range(self.layers):
+            pfx = f"l{i}."
+            hn = self._norm(x, params[pfx + "ln1"])
+            q = self._mm(params, pfx + "wq", hn).reshape(n, h, hd)
+            k = self._mm(params, pfx + "wk", hn).reshape(n, hkv, hd)
+            v = self._mm(params, pfx + "wv", hn).reshape(n, hkv, hd)
+            kc = kc[:i] + (jax.vmap(write_row)(kc[i], k, pos),) + kc[i + 1:]
+            vc = vc[:i] + (jax.vmap(write_row)(vc[i], v, pos),) + vc[i + 1:]
+            a = decode_attention(q, kc[i], vc[i], att_len,
+                                 force=self.attention)
+            x = x + self._mm(params, pfx + "wo", a.reshape(n, h * hd))
+            hn2 = self._norm(x, params[pfx + "ln2"])
+            x = x + self._mm(params, pfx + "w2",
+                             jax.nn.gelu(self._mm(params, pfx + "w1",
+                                                  hn2)))
+        logits = self._mm(params, "head", self._norm(x, params["lnf"]))
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, tokens)
+        new_len = jnp.where(active, pos + 1, lengths)
+        return kc, vc, nxt, new_len, logits
+
+
+# -- sessions ---------------------------------------------------------------
+
+class SessionPoolFull(devstats.HBMPreflightError):
+    """No free KV block and the wait queue is at capacity. Subclasses the
+    HBM preflight error so frontend.status_for maps it to HTTP 507 —
+    the block the session needs IS pool memory."""
+
+
+class Session:
+    """One generation request: prompt in, greedy token stream out."""
+
+    __slots__ = ("sid", "prompt", "max_new", "eos_id", "tokens", "slot",
+                 "future", "t_submit", "t_done")
+
+    def __init__(self, sid, prompt, max_new, eos_id, deadline):
+        self.sid = sid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.tokens = []
+        self.slot = None
+        self.future = Future(deadline)
+        self.t_submit = time.monotonic()
+        self.t_done = None
+
+    def result(self, timeout=None):
+        return self.future.result(timeout)
+
+
+class SessionPool:
+    """Slot bookkeeping for the KV pool: free list, wait queue, admission.
+
+    The caller (DecodeEngine) holds its lock around every method. A
+    session is admitted iff a block or a queue seat exists; it binds to a
+    concrete slot at prefill time and frees it at retirement — EOS,
+    token budget, or max_len, whichever first."""
+
+    def __init__(self, num_slots, max_len, session_bytes, queue_depth=None):
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.session_bytes = int(session_bytes)
+        self.queue_depth = (2 * self.num_slots if queue_depth is None
+                            else int(queue_depth))
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self._by_slot = {}
+        self._pending = deque()
+        self.admitted = 0
+        self.rejected = 0
+        self.retired = 0
+
+    def occupancy(self):
+        return self.num_slots - len(self._free)
+
+    def depth(self):
+        return len(self._pending)
+
+    def admit(self, sess):
+        if len(self._pending) >= self.queue_depth and not self._free:
+            self.rejected += 1
+            raise SessionPoolFull(
+                f"decode pool full: {self.num_slots} KV blocks "
+                f"({self.session_bytes} B each) busy and wait queue at "
+                f"{self.queue_depth}")
+        self._pending.append(sess)
+        self.admitted += 1
+
+    def assign(self):
+        """Bind queued sessions to free slots; returns the newly bound."""
+        out = []
+        while self._pending and self._free:
+            sess = self._pending.popleft()
+            sess.slot = self._free.pop()
+            self._by_slot[sess.slot] = sess
+            out.append(sess)
+        return out
+
+    def retire(self, slot):
+        sess = self._by_slot.pop(slot)
+        self._free.append(slot)
+        self.retired += 1
+        return sess
+
+    def active_sessions(self):
+        return dict(self._by_slot)
+
+
+# -- engine -----------------------------------------------------------------
+
+def _load_decode_artifact(path):
+    """Read a decode .mxa (contrib.export.export_decode_model): manifest
+    ``decode`` block -> DecodeModel config, params.bin -> param dict
+    (fp8 tensors ride as uint8 bytes; the quant block says which to
+    view back). Returns (config, params, model_name, quant)."""
+    from ..predictor import _read_container_dense
+    with zipfile.ZipFile(path) as zf:
+        manifest = json.loads(zf.read("MANIFEST.json"))
+        raw = _read_container_dense(zf.read("params.bin"))
+    dec = manifest.get("decode")
+    if dec is None:
+        raise MXNetError(f"{path}: no 'decode' block in manifest — not a "
+                         "decode artifact (use ServingEngine for predict "
+                         "models)")
+    params = {n.split(":", 1)[1]: v for n, v in raw.items()}
+    quant = manifest.get("quant")
+    if quant and quant.get("dtype") == "fp8":
+        from ..ops.quantization import _fp8_dtype
+        f8 = _fp8_dtype()
+        if f8 is None:
+            raise MXNetError(f"{path}: fp8 artifact but this jax has no "
+                             "float8_e4m3fn")
+        for n in quant.get("params", []):
+            params[n] = params[n].view(f8)
+    return dec, params, manifest.get("model_name"), quant
+
+
+class DecodeEngine:
+    """Continuous-batching decode runtime over one :class:`DecodeModel`.
+
+    A background loop owns the device state (params, KV pool, per-slot
+    token/length/active vectors): it prefify-admits queued sessions into
+    free slots, then dispatches THE decode-step plan while anyone is
+    active. Callers interact through :meth:`submit` (non-blocking,
+    returns a :class:`Session` whose future resolves to the token list)
+    or :meth:`generate` (blocking convenience).
+
+    Accepts a (model, params) pair or a decode ``.mxa`` path."""
+
+    def __init__(self, model, params=None, num_slots=None, max_len=None,
+                 queue_depth=None, attention=None, matmul=None, name=None,
+                 warmup=True):
+        import jax
+        if isinstance(model, (str, os.PathLike)):
+            cfg, params, mname, _quant = _load_decode_artifact(str(model))
+            if max_len is not None:
+                cfg = dict(cfg, max_len=int(max_len))
+            model = DecodeModel.from_config(cfg, attention=attention,
+                                            matmul=matmul)
+            name = name or mname
+        self.model = model
+        self.name = str(name) if name else "decode"
+        self.num_slots = int(num_slots) if num_slots \
+            else _int_knob("MXNET_DECODE_SLOTS")
+        self.max_len = model.max_len
+        self.max_prompt = self.max_len - 1   # >= 1 token must be generable
+        if params is None:
+            raise MXNetError("DecodeEngine: params required with a model "
+                             "instance")
+
+        self._names = sorted(params)
+        self._flat = tuple(jax.device_put(np.asarray(params[n]))
+                           for n in self._names)
+        self.params_bytes = sum(int(v.nbytes) for v in self._flat)
+        self.session_bytes = model.session_cache_bytes()
+        self.cache_bytes = self.num_slots * self.session_bytes
+        # pool admission: the whole KV pool + weights must fit the HBM
+        # budget BEFORE we allocate — a sized 507 beats an OOM later
+        if devstats.enabled():
+            devstats.preflight("%s.pool" % self.name,
+                               self.cache_bytes + self.params_bytes,
+                               what="decode KV pool + weights")
+        self._k, self._v = model.init_cache(self.num_slots)
+        self._tokens = np.zeros(self.num_slots, np.int32)
+        self._lengths = np.zeros(self.num_slots, np.int32)
+        self._active = np.zeros(self.num_slots, np.bool_)
+
+        self.pool = SessionPool(self.num_slots, self.max_len,
+                                self.session_bytes, queue_depth)
+        self._buckets = prompt_buckets(self.max_len)
+        self._step_plan = None
+        self._prefill_plans = {}
+        self.plan_compiles = 0
+        self.step_compiles = 0      # MUST stay 1: occupancy never re-keys
+        self.plan_resident_bytes = 0
+        self.step_executions = 0
+        self.prefill_executions = 0
+        self.tokens_generated = 0
+        self.sessions_done = 0
+        self._t0 = time.monotonic()
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._closed = False
+
+        # one series per engine name under shared metric names (the
+        # registry keys on (name, series)), so concurrent engines — tests,
+        # router-managed models — never fight over label sets
+        from ..telemetry import counter, gauge, histogram
+        labels = {"engine": self.name}
+        self._m_tokens = counter(
+            "mxnet_decode_tokens_total",
+            help="greedy tokens emitted across all sessions",
+            labels=labels, series=self.name)
+        self._m_occ = gauge(
+            "mxnet_decode_kv_occupancy",
+            help="KV-pool slots holding a live session", labels=labels,
+            series=self.name)
+        self._m_cache = gauge(
+            "mxnet_decode_kv_cache_bytes",
+            help="bytes preallocated for the KV pool", labels=labels,
+            series=self.name)
+        self._m_step = histogram(
+            "mxnet_decode_step_seconds",
+            help="wall time of one decode-step dispatch", labels=labels,
+            series=self.name)
+        self._m_cache.set(self.cache_bytes)
+        self._m_occ.set(0)
+
+        if warmup:
+            self._ensure_step_plan()
+            self._prefill_plan(self._buckets[0])
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"{self.name}-loop",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- plans --------------------------------------------------------------
+
+    def _record_plan(self, label, compiled):
+        """devstats accounting, mirroring ServingEngine._plan: record the
+        program, preflight its peak against what's already resident."""
+        self.plan_compiles += 1
+        if not devstats.enabled():
+            return
+        pname = f"{self.name}.{label}"
+        stats = devstats.record_program(pname, compiled=compiled,
+                                        kind="serving")
+        resident = int(stats["generated_code_bytes"]
+                       or (stats["argument_bytes"]
+                           + stats["output_bytes"]))
+        devstats.preflight(pname, int(stats["peak_bytes"]),
+                           resident_bytes=self.plan_resident_bytes,
+                           what="decode plan")
+        devstats.note_compile(pname)
+        self.plan_resident_bytes += resident
+
+    def _specs(self, arrays):
+        import jax
+        return tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in arrays)
+
+    def _ensure_step_plan(self):
+        if self._step_plan is not None:
+            return self._step_plan
+        import jax
+        import jax.numpy as jnp
+        model, names = self.model, self._names
+
+        def step_fn(flat, kc, vc, tokens, lengths, active):
+            kc, vc, nxt, ln, _ = model.step(dict(zip(names, flat)),
+                                            kc, vc, tokens, lengths,
+                                            active)
+            return kc, vc, nxt, ln
+
+        n = self.num_slots
+        specs = (self._specs(self._flat), self._specs(self._k),
+                 self._specs(self._v),
+                 jax.ShapeDtypeStruct((n,), jnp.int32),
+                 jax.ShapeDtypeStruct((n,), jnp.int32),
+                 jax.ShapeDtypeStruct((n,), jnp.bool_))
+        # donate the caches: steady-state decode holds ONE pool, and the
+        # executable aliases inputs to outputs (hloaudit checks this)
+        self._step_plan = jax.jit(
+            step_fn, donate_argnums=(1, 2)).lower(*specs).compile()
+        self.step_compiles += 1
+        self._record_plan("step", self._step_plan)
+        return self._step_plan
+
+    def _prefill_plan(self, bucket):
+        plan = self._prefill_plans.get(bucket)
+        if plan is not None:
+            return plan
+        import jax
+        import jax.numpy as jnp
+        model, names = self.model, self._names
+
+        def prefill_fn(flat, kc, vc, tokens, true_len, slot):
+            return model.prefill(dict(zip(names, flat)), kc, vc,
+                                 tokens, true_len, slot)
+
+        specs = (self._specs(self._flat), self._specs(self._k),
+                 self._specs(self._v),
+                 jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        plan = jax.jit(
+            prefill_fn, donate_argnums=(1, 2)).lower(*specs).compile()
+        self._record_plan("prefill.b%d" % bucket, plan)
+        self._prefill_plans[bucket] = plan
+        return plan
+
+    def _bucket_for(self, n):
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               timeout_ms=None):
+        """Queue one generation; returns a :class:`Session` immediately.
+        Raises ValueError on a malformed/oversized prompt (HTTP 400) and
+        :class:`SessionPoolFull` when no KV block or queue seat exists
+        (HTTP 507)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("decode: empty prompt")
+        if any(t < 0 or t >= self.model.vocab for t in prompt):
+            raise ValueError("decode: prompt token outside vocab "
+                             f"[0, {self.model.vocab})")
+        if len(prompt) > self.max_prompt:
+            raise ValueError(
+                f"decode: prompt length {len(prompt)} exceeds "
+                f"max_len-1 = {self.max_prompt} (KV block holds "
+                f"{self.max_len} positions incl. generated tokens)")
+        max_new = int(max_new_tokens) if max_new_tokens \
+            else _int_knob("MXNET_DECODE_MAX_NEW")
+        if max_new < 1:
+            raise ValueError("decode: max_new_tokens must be >= 1")
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms else None)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("DecodeEngine is closed")
+            self._seq += 1
+            sess = Session(self._seq, prompt, max_new, eos_id, deadline)
+            self.pool.admit(sess)
+            self._cv.notify_all()
+        return sess
+
+    def generate(self, prompt, max_new_tokens=None, eos_id=None,
+                 timeout_ms=None):
+        """Blocking submit: returns the generated token list."""
+        return self.submit(prompt, max_new_tokens, eos_id,
+                           timeout_ms).result()
+
+    def stats(self):
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        with self._cv:
+            occ, depth = self.pool.occupancy(), self.pool.depth()
+        return {"engine": self.name, "num_slots": self.num_slots,
+                "max_len": self.max_len, "occupancy": occ,
+                "queue_depth": depth,
+                "sessions_admitted": self.pool.admitted,
+                "sessions_rejected": self.pool.rejected,
+                "sessions_done": self.sessions_done,
+                "tokens_generated": self.tokens_generated,
+                "tokens_per_s": self.tokens_generated / dt,
+                "step_executions": self.step_executions,
+                "prefill_executions": self.prefill_executions,
+                "plan_compiles": self.plan_compiles,
+                "plan_resident_bytes": self.plan_resident_bytes,
+                "session_cache_bytes": self.session_bytes,
+                "kv_cache_bytes": self.cache_bytes,
+                "params_bytes": self.params_bytes}
+
+    def resident_bytes(self):
+        return self.cache_bytes + self.params_bytes \
+            + self.plan_resident_bytes
+
+    def close(self, drain=True):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self.pool._pending:
+                    sess = self.pool._pending.popleft()
+                    sess.future._set_exception(
+                        RuntimeError("DecodeEngine closed"))
+            self._cv.notify_all()
+        self._thread.join(timeout=60.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- decode loop --------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while (not self.pool._pending and not self.pool._by_slot
+                       and not self._closed):
+                    self._cv.wait()
+                if (self._closed and not self.pool._pending
+                        and not self.pool._by_slot):
+                    return
+                newly = self.pool.assign()
+                self._m_occ.set(self.pool.occupancy())
+            for sess in newly:
+                try:
+                    self._do_prefill(sess)
+                except Exception as e:           # noqa: BLE001
+                    sess.future._set_exception(e)
+                    with self._cv:
+                        self.pool.retire(sess.slot)
+                        self._active[sess.slot] = False
+                        self._m_occ.set(self.pool.occupancy())
+            if self._active.any():
+                self._do_step()
+
+    def _do_prefill(self, sess):
+        bucket = self._bucket_for(len(sess.prompt))
+        plan = self._prefill_plan(bucket)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(sess.prompt)] = sess.prompt
+        self._k, self._v, tok0, _ = plan(
+            self._flat, self._k, self._v, toks,
+            np.int32(len(sess.prompt)), np.int32(sess.slot))
+        self.prefill_executions += 1
+        tok0 = int(tok0)
+        slot = sess.slot
+        self._tokens[slot] = tok0
+        self._lengths[slot] = len(sess.prompt)
+        self._active[slot] = True
+        self._emit(sess, tok0)
+
+    def _do_step(self):
+        t0 = time.perf_counter()
+        plan = self._ensure_step_plan()
+        self._k, self._v, nxt, new_len = plan(
+            self._flat, self._k, self._v, self._tokens, self._lengths,
+            self._active)
+        self.step_executions += 1
+        self._tokens = np.array(nxt, np.int32)
+        self._lengths = np.array(new_len, np.int32)
+        self._m_step.observe(time.perf_counter() - t0)
+        with self._cv:
+            live = list(self.pool._by_slot.items())
+        for slot, sess in live:
+            if self._active[slot]:
+                self._emit(sess, int(self._tokens[slot]))
+
+    def _emit(self, sess, tok):
+        """Record one generated token; retire the session when its stream
+        is complete (EOS, token budget, or cache exhausted)."""
+        sess.tokens.append(tok)
+        self.tokens_generated += 1
+        self._m_tokens.inc()
+        done = (len(sess.tokens) >= sess.max_new
+                or (sess.eos_id is not None and tok == sess.eos_id)
+                # the next step would write this token's K/V at position
+                # lengths — no position left means the stream ends here
+                or int(self._lengths[sess.slot]) >= self.max_len)
+        if done:
+            with self._cv:
+                self.pool.retire(sess.slot)
+                self._active[sess.slot] = False
+                self._m_occ.set(self.pool.occupancy())
+            sess.t_done = time.monotonic()
+            self.sessions_done += 1
+            sess.future._set(list(sess.tokens))
+
+
+# -- selftest ---------------------------------------------------------------
+
+def _selftest(sessions=8, new_tokens=40, stagger_ms=1.0):
+    """8 concurrent staggered sessions vs the same prompts decoded
+    sequentially (one live session at a time) through the SAME engine:
+    token streams must be bit-identical and batched tokens/s strictly
+    (and for the PR gate, >= 3x) higher."""
+    model = DecodeModel(vocab=64, layers=2, d_model=64, heads=4,
+                        kv_heads=2, d_ff=128, max_len=64)
+    params = model.init_params(seed=7)
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, model.vocab, size=rng.randint(3, 8)).tolist()
+               for _ in range(sessions)]
+    eng = DecodeEngine(model, params, num_slots=sessions, name="selftest")
+    try:
+        # warm every bucket the prompts will touch + the step plan
+        eng.generate(prompts[0], max_new_tokens=2)
+
+        t0 = time.perf_counter()
+        seq = [eng.generate(p, max_new_tokens=new_tokens)
+               for p in prompts]
+        t_seq = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        pending = []
+        for p in prompts:
+            pending.append(eng.submit(p, max_new_tokens=new_tokens))
+            time.sleep(stagger_ms / 1000.0)   # staggered joins
+        conc = [s.result(timeout=120.0) for s in pending]
+        t_conc = time.perf_counter() - t0
+
+        n_tok = sessions * new_tokens
+        seq_tps = n_tok / t_seq
+        conc_tps = n_tok / t_conc
+        identical = conc == seq
+        speedup = conc_tps / seq_tps
+        stats = eng.stats()
+    finally:
+        eng.close()
+    return {"metric": "decode_selftest", "sessions": sessions,
+            "new_tokens": new_tokens, "identical": bool(identical),
+            "seq_tokens_per_s": round(seq_tps, 1),
+            "batched_tokens_per_s": round(conc_tps, 1),
+            "speedup": round(speedup, 2),
+            "step_executions": stats["step_executions"],
+            "plan_compiles": stats["plan_compiles"],
+            "kv_cache_bytes": stats["kv_cache_bytes"],
+            "ok": bool(identical and speedup > 1.0)}
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.serving.decode",
+        description="continuous-batching decode engine selftest")
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--sessions", type=int, default=8)
+    ap.add_argument("--new-tokens", type=int, default=40)
+    args = ap.parse_args(argv)
+    if not args.selftest:
+        ap.error("nothing to do (pass --selftest)")
+    out = _selftest(sessions=args.sessions, new_tokens=args.new_tokens)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

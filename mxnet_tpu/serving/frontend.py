@@ -150,6 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._body()
             if verb == "predict":
                 self._predict(name, body)
+            elif verb == "generate":
+                self._generate(name, body)
             elif verb == "load":
                 st = self.frontend.router.load(name, str(body["path"]))
                 self._reply(200, st)
@@ -186,6 +188,26 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {"model": name,
                           "outputs": [np.asarray(o).tolist()
                                       for o in outs]})
+
+    def _generate(self, name, body):
+        """Decode-model session API: {"tokens": [...], "max_new_tokens":
+        N, "eos_id": E, "timeout_ms": T} -> the greedy completion. The
+        session blocks this handler thread only (ThreadingHTTPServer);
+        the decode loop packs it with every other live session."""
+        tokens = body.get("tokens")
+        if tokens is None:
+            raise ValueError("generate body needs 'tokens' (prompt ids)")
+        if not isinstance(tokens, list):
+            raise ValueError("'tokens' must be a list of token ids")
+        sess = self.frontend.router.generate(
+            name, tokens,
+            max_new_tokens=body.get("max_new_tokens"),
+            eos_id=body.get("eos_id"),
+            timeout_ms=body.get("timeout_ms"))
+        out = sess.result()
+        self._reply(200, {"model": name, "session": sess.sid,
+                          "prompt_tokens": len(tokens),
+                          "tokens": out})
 
 
 class _Server(ThreadingHTTPServer):

@@ -72,6 +72,50 @@ def manifest_need_bytes(path):
         return 0
 
 
+def _manifest_decode_block(path):
+    """The manifest `decode` block when `path` is a decode artifact
+    (contrib.export.export_decode_model), else None."""
+    try:
+        with zipfile.ZipFile(path) as zf:
+            with io.TextIOWrapper(zf.open("MANIFEST.json"),
+                                  encoding="utf-8") as f:
+                return json.load(f).get("decode")
+    except (OSError, KeyError, ValueError, zipfile.BadZipFile):
+        return None
+
+
+class _DecodeAdapter:
+    """Pool-shaped wrapper around one DecodeEngine so decode artifacts
+    sit in the same name table as predict pools. A decode engine is its
+    own concurrency domain (the KV-slot pool), so the router's replica
+    knob does not apply — one engine per name. `submit` (the predict
+    path) is refused with a 400-mapping error; `generate` is the
+    entry point."""
+
+    def __init__(self, path, name=None):
+        from .decode import DecodeEngine
+        self.engine = DecodeEngine(path, name=name)
+
+    def submit(self, *arrays, timeout_ms=None, priority="interactive"):
+        raise ValueError("decode model: POST :generate, not :predict")
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None,
+                 timeout_ms=None):
+        return self.engine.submit(tokens, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id, timeout_ms=timeout_ms)
+
+    def resident_bytes(self):
+        return self.engine.resident_bytes()
+
+    def stats(self):
+        st = self.engine.stats()
+        st["decode"] = True
+        return st
+
+    def close(self, drain=True):
+        self.engine.close(drain=drain)
+
+
 class _Entry:
     __slots__ = ("path", "pool", "need", "last_used", "ready", "error")
 
@@ -168,6 +212,8 @@ class ModelRouter:
     def _build_pool(self, path):
         if self._pool_factory is not None:
             return self._pool_factory(path, replicas=self.replicas)
+        if _manifest_decode_block(path) is not None:
+            return _DecodeAdapter(path)
         return EnginePool(path, replicas=self.replicas, **self._pool_kw)
 
     # -- public API ----------------------------------------------------------
@@ -249,6 +295,32 @@ class ModelRouter:
         fut, _ = entry.pool.submit(*arrays, timeout_ms=timeout_ms,
                                    priority=priority)
         return fut
+
+    def generate(self, name, tokens, max_new_tokens=None, eos_id=None,
+                 timeout_ms=None):
+        """Route one autoregressive generation to a decode model;
+        returns the engine's Session (future resolves to the token
+        list). ValueError when the name holds a predict-only model
+        (HTTP 400 at the frontend); UnknownModel when absent."""
+        with self._lock:
+            entry = self._models.get(str(name))
+            if entry is not None and entry.pool is not None:
+                self._touch(entry)
+        if entry is None:
+            raise UnknownModel(f"model {name!r} is not loaded")
+        if entry.pool is None:
+            entry.ready.wait()
+            with self._lock:
+                entry = self._models.get(str(name))
+                if entry is None or entry.pool is None:
+                    raise UnknownModel(f"model {name!r} is not loaded")
+                self._touch(entry)
+        gen = getattr(entry.pool, "generate", None)
+        if gen is None:
+            raise ValueError(f"model {name!r} is not a decode model "
+                             "(use :predict)")
+        return gen(tokens, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                   timeout_ms=timeout_ms)
 
     def unload(self, name):
         """Drop a model; its pool (and every compiled plan) is closed.

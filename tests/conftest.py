@@ -97,6 +97,9 @@ _QUICK = {
     "test_frontend.py::test_least_loaded_dispatch_picks_idle_replica",
     "test_frontend.py::test_admission_class_shed_ordering",
     "test_frontend.py::test_http_status_mapping",
+    "test_decode.py::test_decode_matches_full_context_recompute",
+    "test_decode.py::test_pool_full_admission_is_sized_507",
+    "test_decode.py::test_quantized_matmul_matches_dequant_then_matmul",
     "test_analysis.py::test_repo_is_clean_under_strict",
     "test_analysis.py::test_amp_wire_invariant_via_auditor",
     "test_analysis.py::test_tracelint_item_sync_in_scanned_step",

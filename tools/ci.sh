@@ -64,6 +64,9 @@ case "$stage" in
     echo "== embedding smoke (row-sparse exchange parity, resume, HLO wire)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.parallel.embedding --selftest
+    echo "== decode smoke (continuous batching: 8 staggered sessions, bit-identical, faster than sequential)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.serving.decode --selftest
     echo "== static analysis (tracelint/locklint/commlint/leaklint/configlint/hloaudit, --strict gate)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.analysis --strict ;;
