@@ -1016,6 +1016,40 @@ def _zero_lane():
         f"{(proc.stderr or '').strip()[-300:]}")
 
 
+def _plan_lane():
+    """Sharding-planner A/B (mxnet_tpu.parallel.planner, ISSUE 19):
+    MXNET_PLAN=auto vs hand-picked dp and zero2 on the transformer-scale
+    arm (wide FC stack, small per-device batch, adam — parameter
+    gather/reduce wire and de-replicated update work dominate) on an
+    8-virtual-device cpu mesh. Reports measured steps/s per arm, the
+    planner's decision and its predicted cost ranking. Runs `python -m
+    mxnet_tpu.parallel.planner --bench` in a fresh subprocess: the
+    8-device backend must be pinned before jax initializes, and this
+    process already consumed it."""
+    import subprocess
+    import sys
+
+    env = os.environ.copy()
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.parallel.planner", "--bench",
+         "--devices", "8", "--steps", "4" if QUICK else "8"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "plan_bench":
+            rec.pop("metric")
+            return rec
+    raise RuntimeError(
+        f"plan bench subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or '').strip()[-300:]}")
+
+
 def _dlrm_lane():
     """Row-sparse embedding exchange A/B (mxnet_tpu.parallel.embedding,
     ISSUE 16): a DLRM-style step — sharded 65k-row table, deduped
@@ -1763,7 +1797,17 @@ def main(argv=None):
         zero_lane = {"status": "skipped: budget"}
     except Exception as e:
         zero_lane = {"status": f"unavailable: {type(e).__name__}"}
+
     _emit("zero", zero_lane)
+    # cost-model sharding planner: MXNET_PLAN=auto vs hand-picked dp /
+    # zero2 on the transformer-scale arm at 8 devices (ISSUE 19)
+    try:
+        plan_lane = _gated("plan", 240, _plan_lane)
+    except _BudgetExceeded:
+        plan_lane = {"status": "skipped: budget"}
+    except Exception as e:
+        plan_lane = {"status": f"unavailable: {type(e).__name__}"}
+    _emit("plan", plan_lane)
     # DLRM-style sharded embedding: row-sparse deduped exchange (+fp8
     # wire) vs dense replicated-table all-reduce at 8 devices (ISSUE 16)
     try:
@@ -1956,6 +2000,15 @@ def main(argv=None):
         "zero_wire_bytes_per_step_zero2_fp8": zero_lane.get(
             "wire_bytes_per_step_zero2_fp8"),
         "zero_devices": zero_lane.get("devices"),
+        # sharding planner (ISSUE 19): the auto-selected composition and
+        # whether it held up against the hand-tuned single modes (full
+        # payload streamed above as the "plan" lane line)
+        "plan_auto_choice": plan_lane.get(
+            "auto_choice", plan_lane.get("status")),
+        "plan_auto_steps_per_s": plan_lane.get("auto_steps_per_s"),
+        "plan_dp_steps_per_s": plan_lane.get("dp_steps_per_s"),
+        "plan_zero2_steps_per_s": plan_lane.get("zero2_steps_per_s"),
+        "plan_auto_beats_hand": plan_lane.get("auto_beats_hand"),
         # DLRM sharded embedding (ISSUE 16): deduped row exchange vs
         # dense table all-reduce at 8 devices (full payload streamed
         # above as the "dlrm" lane line)

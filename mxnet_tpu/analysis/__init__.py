@@ -57,6 +57,7 @@ DEFAULT_HLO_BUDGETS = {
     "fit_step_zero": {"convert_max": 16, "recompile_max": 1},
     "serving_bucket": {"convert_max": 4, "recompile_max": 1},
     "fit_decode": {"convert_max": 32, "recompile_max": 1},
+    "fit_step_plan": {"convert_max": 8, "recompile_max": 1},
 }
 
 

@@ -50,7 +50,8 @@ ITEMSIZE = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8,
             "f8e4m3fn": 1, "f8e5m2": 1}
 
 PROGRAMS = ("fit_step_fp32", "fit_step_bf16", "fit_step_zero",
-            "fit_step_embedding", "serving_bucket", "fit_decode")
+            "fit_step_embedding", "serving_bucket", "fit_decode",
+            "fit_step_plan")
 
 # the cross-device data-movement ops the ZeRO lane audits. "-start"
 # suffixed async forms are matched alongside the synchronous spelling;
@@ -65,6 +66,7 @@ _PROGRAM_FILE = {
     "fit_step_embedding": "parallel/embedding.py",
     "serving_bucket": "serving/engine.py",
     "fit_decode": "serving/decode.py",
+    "fit_step_plan": "parallel/planner.py",
 }
 
 
@@ -519,6 +521,29 @@ def _audit_programs():
         }
     finally:
         deng.close(drain=False)
+
+    # fit_step_plan: the planner's chosen dp×tp+ZeRO-2 composition on
+    # an 8-device virtual mesh (parallel/planner.py --hlo-audit). This
+    # process is pinned to 2 cpu devices above, so the 8-device compile
+    # runs in its own subprocess and its record merges here; a dead
+    # subprocess reports zeroed collectives, which the findings rules
+    # flag loudly (missing reduce-scatter/all-gather are P0s).
+    proc = _sub(["mxnet_tpu.parallel.planner", "--hlo-audit"], 600)
+    prec = parse_last_metric(proc.stdout, "planner_hlo_audit")
+    if proc.returncode != 0 or not prec:
+        out["programs"]["fit_step_plan"] = {
+            "error": f"rc={proc.returncode}: "
+                     f"{(proc.stderr or proc.stdout or '')[-300:]}",
+            "allreduce_sync": 0, "allreduce_async": 0,
+            "reduce_scatter": 0, "all_gather": 0,
+            "grad_allreduce_nonscalar": 0, "wire_within_10pct": False,
+            "wire_bytes_hlo": 0, "wire_bytes_estimate": 0,
+            "pairing_ok": True, "has_f64": False, "convert_count": 0,
+            "donated": [], "donate_expected": 0, "recompiles": 0,
+            "cost": {}}
+    else:
+        prec.pop("metric", None)
+        out["programs"]["fit_step_plan"] = prec
     print(json.dumps(out), flush=True)
     return 0
 
@@ -617,6 +642,39 @@ def findings_from_report(rec, baseline=None):
                     f"{prog}: sparse exchange moves {w1} wire bytes "
                     f"vs the dense baseline's {wd} — the row-sparse "
                     f"path lost its reason to exist", scope=prog))
+        if prog == "fit_step_plan":
+            # the planner-composition invariants (ZeRO-2 over a dp×tp
+            # mesh): grads move via a JOINT-axis reduce-scatter, params
+            # re-materialize via a joint all-gather, and the compiled
+            # wire bytes must agree with the planner's analytic
+            # estimate — the number its cost model ranked plans with
+            if not r.get("reduce_scatter"):
+                findings.append(Finding(
+                    "hlo-plan-missing-reduce-scatter", "P0", file, 0,
+                    f"{prog}: no reduce-scatter in the compiled "
+                    f"dp×tp+ZeRO-2 step — joint-axis gradient sharding "
+                    f"is not happening", scope=prog))
+            if not r.get("all_gather"):
+                findings.append(Finding(
+                    "hlo-plan-missing-allgather", "P0", file, 0,
+                    f"{prog}: no all-gather in the compiled "
+                    f"dp×tp+ZeRO-2 step — sharded masters are never "
+                    f"re-materialized for compute", scope=prog))
+            if r.get("grad_allreduce_nonscalar"):
+                findings.append(Finding(
+                    "hlo-plan-grad-allreduce", "P1", file, 0,
+                    f"{prog}: {r['grad_allreduce_nonscalar']} "
+                    f"gradient-sized all-reduce(s) — the joint sharding "
+                    f"regressed to replicated dp", scope=prog))
+            if not r.get("wire_within_10pct"):
+                findings.append(Finding(
+                    "hlo-plan-wire-estimate", "P1", file, 0,
+                    f"{prog}: compiled HLO moves "
+                    f"{r.get('wire_bytes_hlo')} wire bytes but the "
+                    f"planner's estimate was "
+                    f"{r.get('wire_bytes_estimate')} (>10% apart) — "
+                    f"the cost model is ranking plans on bad numbers",
+                    scope=prog))
         if prog == "fit_decode" and not r.get("int8_operands"):
             # the quantized-matmul invariant: calibrated int8 weights
             # must reach the fused dot as s8 operands — a convert back

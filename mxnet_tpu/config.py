@@ -131,6 +131,19 @@ _DOCUMENTED = {
     "MXNET_ZERO_STAGE": 0,
     "MXNET_ZERO_BUCKET_MB": "4",
     "MXNET_GRAD_COMPRESS": "none",
+    # unified N-D parallelism planner (mxnet_tpu.parallel.planner,
+    # docs/PLANNER.md): MXNET_PLAN picks the sharding composition —
+    # auto (cost-model argmin over dp/zero1/zero2/dpK.tpT[+zero2]
+    # candidates), or an explicit spec. The chosen plan auto-tunes
+    # MXNET_ZERO_STAGE / MXNET_ZERO_BUCKET_MB / MXNET_GRAD_COMPRESS /
+    # MXNET_DEVICE_FEED / MXNET_DEVICE_FEED_DEPTH / MXNET_FUSED_K,
+    # each only when the user left it unset ("auto unless set").
+    # MXNET_PLAN_WIRE_GBPS is the cross-device bandwidth (GB/s) the
+    # cost model prices collective wire bytes with; MXNET_FUSED_K is
+    # gluon fused_fit's steps-per-dispatch default (0 = auto = 8)
+    "MXNET_PLAN": "auto",
+    "MXNET_PLAN_WIRE_GBPS": "25",
+    "MXNET_FUSED_K": 0,
     # sharded-embedding row-sparse exchange (mxnet_tpu.parallel.
     # embedding, docs/SPARSE.md): MXNET_EMBED_EXCHANGE picks how
     # embedding gradients cross the wire (sparse = deduped touched rows,

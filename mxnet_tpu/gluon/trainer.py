@@ -28,9 +28,9 @@ __all__ = ["Trainer", "fused_fit"]
 
 
 def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
-              optimizer_params=None, steps_per_dispatch=8, contexts=None,
-              dtype=None, epoch_callback=None, checkpoint_dir=None,
-              checkpoint_period=None, resume=False):
+              optimizer_params=None, steps_per_dispatch=None,
+              contexts=None, dtype=None, epoch_callback=None,
+              checkpoint_dir=None, checkpoint_period=None, resume=False):
     """K-steps-per-dispatch training driver for gluon nets
     (steps_per_dispatch, beyond-reference; Module.fit's equivalent knob).
 
@@ -193,6 +193,11 @@ def fused_fit(net, loss, train_data, num_epoch, optimizer="sgd",
         ys = np.stack([_np_of(b[1]) for b in block])
         return trainer.shard_inputs([xs, ys], stacked=True), len(block)
 
+    # default K comes from MXNET_FUSED_K (the planner auto-tunes it per
+    # chosen plan, "auto unless set"); 0/unset keeps the historical 8
+    if steps_per_dispatch is None:
+        from .. import config
+        steps_per_dispatch = int(config.get("MXNET_FUSED_K", 0)) or 8
     k = int(steps_per_dispatch)
     epoch_losses = []
     from ..telemetry import maybe_step_logger

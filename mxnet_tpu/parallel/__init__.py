@@ -9,15 +9,24 @@ over a `jax.sharding.Mesh`. Batch inputs are sharded along the mesh's data
 axis; parameters are replicated; XLA inserts the psum over ICI where the
 scalar loss sums across the sharded batch. Multi-host: the same program runs
 under jax.distributed with a global mesh (DCN between slices).
+
+The compositions — dp×tp GSPMD layouts, ZeRO over any mesh's joint axes —
+are unified by `planner` (MXNET_PLAN): one `Plan` names the mesh shape,
+layout and knob settings, and `planner.make_trainer` builds (or cost-model
+auto-selects) the trainer it describes (docs/PLANNER.md).
 """
-from .mesh import (build_mesh, data_parallel_mesh, mesh_for_contexts,
-                   mesh_for_devices, replicated_sharding, batch_sharding,
+from .mesh import (build_mesh, data_parallel_mesh, single_axis_mesh,
+                   mesh_for_contexts, mesh_for_devices, axis_size,
+                   data_axis, mesh_descriptor, mesh_from_descriptor,
+                   replicated_sharding, batch_sharding,
                    put_replicated, put_batch_sharded)
 from .dp import DataParallelTrainer
 from . import zero
 from .zero import ZeroTrainer
 from . import embedding
 from .embedding import EmbeddingTrainer
+from . import planner
+from .planner import Plan, make_trainer
 from . import sp
 from . import tp
 from . import pp
@@ -25,9 +34,12 @@ from .sp import ring_attention, ulysses_attention
 from .tp import megatron_mlp, moe_ffn
 from .pp import pipeline_mlp
 
-__all__ = ["build_mesh", "data_parallel_mesh", "DataParallelTrainer",
-           "ZeroTrainer", "zero", "EmbeddingTrainer", "embedding",
-           "mesh_for_contexts", "mesh_for_devices", "replicated_sharding",
+__all__ = ["build_mesh", "data_parallel_mesh", "single_axis_mesh",
+           "DataParallelTrainer", "ZeroTrainer", "zero",
+           "EmbeddingTrainer", "embedding", "planner", "Plan",
+           "make_trainer", "mesh_for_contexts", "mesh_for_devices",
+           "axis_size", "data_axis", "mesh_descriptor",
+           "mesh_from_descriptor", "replicated_sharding",
            "batch_sharding", "put_replicated", "put_batch_sharded",
            "sp", "tp", "pp", "ring_attention", "ulysses_attention",
            "megatron_mlp", "moe_ffn", "pipeline_mlp"]

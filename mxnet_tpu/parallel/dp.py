@@ -15,6 +15,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..executor import _build_runner
+from .mesh import data_axis as _mesh_data_axis
 
 
 # optimizer name -> fused update op (ops/optimizer_ops.py). All state
@@ -59,8 +60,9 @@ class DataParallelTrainer:
                  label_names=("softmax_label",), optimizer="sgd",
                  learning_rate=0.01, momentum=0.0, wd=0.0, rescale_grad=None,
                  clip_gradient=None, loss_index=0, dtype="float32",
-                 input_preproc=None, loss_scaler=None, zero_stage=None,
-                 zero_bucket_mb=None, grad_compress=None, **opt_kwargs):
+                 input_preproc=None, loss_scaler=None, param_specs=None,
+                 zero_stage=None, zero_bucket_mb=None, grad_compress=None,
+                 **opt_kwargs):
         # zero_stage/zero_bucket_mb/grad_compress belong to the ZeRO
         # subclass; accepted (and ignored) here so a stage-0 run can keep
         # them in its construction kwargs
@@ -69,7 +71,7 @@ class DataParallelTrainer:
 
         self._symbol = symbol
         self._mesh = mesh
-        self._data_axis = mesh.axis_names[0]
+        self._data_axis = _mesh_data_axis(mesh)
         arg_names = symbol.list_arguments()
         self._arg_names = arg_names
         self._aux_names = symbol.list_auxiliary_states()
@@ -301,14 +303,29 @@ class DataParallelTrainer:
         # batch axis (axis 1) sharded over the mesh
         self._block_shard = NamedSharding(mesh, P(None, self._data_axis))
         self._repl, self._shard = repl, shard
+        # param_specs (name -> PartitionSpec) turns on GSPMD tensor
+        # parallelism: the listed params (and their optimizer state) live
+        # sharded over the named mesh axes and XLA's partitioner inserts
+        # the megatron-style collectives around the matmuls. None keeps
+        # today's replicated-params program BIT-identical (same jit, same
+        # sharding tuple); unlisted params stay replicated.
+        self._param_specs = None
+        self._pshard = None
+        if param_specs:
+            self._param_specs = {str(k): v
+                                 for k, v in dict(param_specs).items()}
+            self._pshard = tuple(
+                NamedSharding(mesh, self._param_specs.get(n, P()))
+                for n in self._param_names)
+        p_io = self._pshard if self._pshard is not None else repl
         self._step_py = step
         self._multi = {}   # (k, outputs_mode) -> jitted K-step scan
         ls_extra = (repl,) if has_ls else ()
         self._step = jax.jit(
             step,
-            in_shardings=(repl, repl, repl, shard, repl, repl, repl)
+            in_shardings=(p_io, p_io, repl, shard, repl, repl, repl)
             + ls_extra,
-            out_shardings=(repl, repl, repl, repl, shard, repl, repl)
+            out_shardings=(p_io, p_io, repl, repl, shard, repl, repl)
             + ls_extra,
             donate_argnums=(0, 1))
 
@@ -368,17 +385,24 @@ class DataParallelTrainer:
                 return params, states, aux, losses, outputs, rng, t
 
         repl, block = self._repl, self._block_shard
+        p_io = self._pshard if self._pshard is not None else repl
         ls_extra = (repl,) if self._has_ls else ()
         fn = jax.jit(
             multi,
-            in_shardings=(repl, repl, repl, block, repl, repl, repl)
+            in_shardings=(p_io, p_io, repl, block, repl, repl, repl)
             + ls_extra,
-            out_shardings=(repl, repl, repl, repl,
+            out_shardings=(p_io, p_io, repl, repl,
                            block if outputs_mode == "all" else repl,
                            repl, repl) + ls_extra,
             donate_argnums=(0, 1))
         self._multi[key] = fn
         return fn
+
+    def _param_sharding(self, i):
+        """Placement of parameter i (and its optimizer state): its
+        param_specs sharding under tensor parallelism, replicated
+        otherwise."""
+        return self._repl if self._pshard is None else self._pshard[i]
 
     @property
     def param_names(self):
@@ -404,7 +428,7 @@ class DataParallelTrainer:
         shapes = dict(zip(self._arg_names, arg_shapes))
         rng = _np.random.RandomState(seed)
         params = []
-        for n in self._param_names:
+        for i, n in enumerate(self._param_names):
             s = shapes[n]
             if arg_params is not None and n in arg_params:
                 a = arg_params[n]
@@ -418,11 +442,12 @@ class DataParallelTrainer:
             else:
                 v = rng.normal(0, 0.01, size=s).astype(_np.float32)
             # host numpy straight onto the mesh (see shard_inputs)
-            params.append(jax.device_put(v, self._repl))
+            params.append(jax.device_put(v, self._param_sharding(i)))
         states = tuple(
-            tuple(jax.device_put(_np.zeros(p.shape, p.dtype), self._repl)
+            tuple(jax.device_put(_np.zeros(p.shape, p.dtype),
+                                 self._param_sharding(i))
                   for _ in range(self._n_states))
-            for p in params)
+            for i, p in enumerate(params))
         aux = tuple(jax.device_put(
             _np.asarray(getattr(aux_params[n], "_data", aux_params[n]),
                         _np.float32)
@@ -586,11 +611,14 @@ class DataParallelTrainer:
         step/step_k; the internal t/rng/loss-scaler carries are restored
         so the continuation is bit-identical to the uninterrupted run."""
         put = lambda v: jax.device_put(_np.asarray(v), self._repl)
-        params = tuple(put(arrays[f"param:{n}"]) for n in self._param_names)
+        pput = lambda v, i: jax.device_put(_np.asarray(v),
+                                           self._param_sharding(i))
+        params = tuple(pput(arrays[f"param:{n}"], i)
+                       for i, n in enumerate(self._param_names))
         states = tuple(
-            tuple(put(arrays[f"opt:{n}:{i}"])
-                  for i in range(self._n_states))
-            for n in self._param_names)
+            tuple(pput(arrays[f"opt:{n}:{j}"], i)
+                  for j in range(self._n_states))
+            for i, n in enumerate(self._param_names))
         aux = tuple(put(arrays[f"aux:{n}"]) for n in self._aux_names)
         self._import_scalar_state(meta)
         return params, states, aux

@@ -64,7 +64,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
-from ._compat import shard_map
+from .mesh import shard_map
 
 __all__ = ["EmbeddingTrainer", "EmbeddingLayout", "counters",
            "resolve_exchange", "resolve_compress", "resolve_unique_cap"]

@@ -1,7 +1,25 @@
-"""Mesh helpers: build jax device meshes for dp/tp/pp axes."""
+"""Mesh helpers: the ONE place device meshes and mesh-axis plumbing
+come from.
+
+Every parallel module (dp/zero/tp/pp/sp/embedding, the planner) builds
+its mesh through these constructors and imports `shard_map`/`pcast`
+from here (re-exported from ._compat, the jax API-drift shim) — a mesh
+axis name used anywhere in the package is declared in AXIS_NAMES, and
+`axis_size`/`data_axis` replace the ad-hoc `mesh.shape[name]` /
+`mesh.axis_names[0]` lookups that used to be copied per module.
+"""
 from __future__ import annotations
 
 import numpy as np
+
+from ._compat import pcast, shard_map  # noqa: F401  (re-exports)
+
+# canonical axis vocabulary (docs/PLANNER.md): data-parallel batch axis,
+# megatron/tensor axis, pipeline-stage axis, sequence axis, expert axis.
+# Aliases map the short spellings the shard_map modules historically
+# used onto the canonical names.
+AXIS_NAMES = ("data", "model", "pipe", "sp", "ep")
+AXIS_ALIASES = {"dp": "data", "tp": "model", "pp": "pipe"}
 
 
 def build_mesh(axis_sizes: dict, devices=None):
@@ -36,6 +54,38 @@ def data_parallel_mesh(n=None, devices=None):
     return build_mesh({"data": n}, devices)
 
 
+def single_axis_mesh(axis_name, n=None, devices=None):
+    """1-D mesh over one named axis — what the shard_map building blocks
+    (tp/pp/sp and their tests/examples) construct instead of an inline
+    ``Mesh(np.array(devices), (name,))``."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    if n is None:
+        n = len(devices)
+    return build_mesh({str(axis_name): n}, list(devices))
+
+
+def axis_size(mesh, axis_name, default=None):
+    """Size of a named mesh axis; `default` (when given) instead of a
+    KeyError for an absent axis, so callers can treat a 1-D data mesh as
+    {'model': 1, 'pipe': 1} without special-casing."""
+    name = AXIS_ALIASES.get(axis_name, axis_name)
+    for n, s in zip(mesh.axis_names, mesh.devices.shape):
+        if n == name or n == axis_name:
+            return int(s)
+    if default is not None:
+        return int(default)
+    raise KeyError(f"mesh {tuple(mesh.axis_names)} has no axis "
+                   f"{axis_name!r}")
+
+
+def data_axis(mesh):
+    """The batch-sharding axis of a mesh: 'data' when present, else the
+    leading axis (the historical 1-D convention)."""
+    return "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+
+
 # One canonical mesh per device tuple so Parameters, Module executors and
 # split_and_load all agree on the mesh object (shardings compare equal).
 _MESH_CACHE: dict = {}
@@ -60,9 +110,26 @@ def mesh_for_contexts(ctx_list):
 def mesh_descriptor(mesh):
     """JSON-safe description of a mesh: {axis_name: size}. Recorded in
     checkpoint TOPOLOGY.json so a restore at a different device count
-    can tell (and log) what it is resharding from."""
+    can tell (and log) what it is resharding from; also the Plan's
+    mesh-shape spelling (parallel/planner.py)."""
     return {str(n): int(s)
             for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def mesh_from_descriptor(desc, devices=None):
+    """Inverse of mesh_descriptor: build (and cache) the mesh a
+    descriptor names. The cache key includes the axis layout, so a
+    dp4×tp2 mesh and a dp8 mesh over the same devices coexist."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    items = tuple((str(k), int(v)) for k, v in desc.items())
+    key = (tuple(devices), items)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = build_mesh(dict(items), list(devices))
+        _MESH_CACHE[key] = mesh
+    return mesh
 
 
 def current_topology(mesh=None):
@@ -86,7 +153,7 @@ def replicated_sharding(mesh):
 
 def batch_sharding(mesh, batch_axis=0):
     from jax.sharding import NamedSharding, PartitionSpec as P
-    spec = [None] * batch_axis + [mesh.axis_names[0]]
+    spec = [None] * batch_axis + [data_axis(mesh)]
     return NamedSharding(mesh, P(*spec))
 
 
@@ -105,9 +172,9 @@ def put_batch_sharded(data, mesh, batch_axis=0):
     data = getattr(data, "_data", data)
     if not isinstance(data, jax.Array):
         data = np.asarray(data)
-    n = mesh.devices.size
+    n = axis_size(mesh, data_axis(mesh))
     if data.shape[batch_axis] % n != 0:
         raise ValueError(
             f"batch axis {batch_axis} of shape {tuple(data.shape)} must be "
-            f"divisible by the {n}-device mesh")
+            f"divisible by the {n}-way data axis")
     return jax.device_put(data, batch_sharding(mesh, batch_axis))

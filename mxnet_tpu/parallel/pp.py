@@ -17,8 +17,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
-from ._compat import pcast as _pcast
-from ._compat import shard_map as _shard_map
+from .mesh import axis_size
+from .mesh import pcast as _pcast
+from .mesh import shard_map as _shard_map
 
 __all__ = ["pipeline_mlp", "pipeline_reference"]
 
@@ -78,7 +79,7 @@ def pipeline_mlp(x_micro, w_stack, b_stack, mesh, axis_name="pp"):
     b_stack (S, D) with S == mesh axis size — stage s lives on device s.
     Returns (M, B, D) replicated outputs.
     """
-    n = mesh.shape[axis_name]
+    n = axis_size(mesh, axis_name)
     if w_stack.shape[0] != n:
         raise MXNetError(
             f"pipeline_mlp: {w_stack.shape[0]} stages but {axis_name} axis "

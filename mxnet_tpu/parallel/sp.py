@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
-from ._compat import shard_map as _shard_map
+from .mesh import axis_size
+from .mesh import shard_map as _shard_map
 
 __all__ = ["attention_reference", "ring_attention", "ulysses_attention"]
 
@@ -182,7 +183,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
         in this build — kernel-level coverage lives in
         tests/test_attention.py and tests_tpu.)
     """
-    nsp = mesh.shape[axis_name]
+    nsp = axis_size(mesh, axis_name)
     if q.shape[2] % nsp != 0:
         raise MXNetError(
             f"ring_attention: sequence {q.shape[2]} not divisible by "
@@ -238,7 +239,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
     sharded axis from sequence to heads, local attention sees the FULL
     sequence for its head group, and a second all-to-all restores
     sequence sharding. Requires heads % axis_size == 0."""
-    nsp = mesh.shape[axis_name]
+    nsp = axis_size(mesh, axis_name)
     if q.shape[1] % nsp != 0:
         raise MXNetError(
             f"ulysses_attention: heads {q.shape[1]} not divisible by "

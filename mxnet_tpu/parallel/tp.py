@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
-from ._compat import shard_map as _shard_map
+from .mesh import axis_size
+from .mesh import shard_map as _shard_map
 
 __all__ = ["megatron_mlp", "moe_ffn", "moe_ffn_reference"]
 
@@ -43,7 +44,7 @@ def megatron_mlp(x, w1, b1, w2, b2, mesh, axis_name="tp"):
 
     H must divide by the axis size. Returns (B, D_out) replicated.
     """
-    n = mesh.shape[axis_name]
+    n = axis_size(mesh, axis_name)
     if w1.shape[1] != w2.shape[0]:
         raise MXNetError(
             f"megatron_mlp: w1 hidden dim {w1.shape[1]} != w2 input dim "
@@ -96,7 +97,7 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis_name="ep"):
     x (B, D); gate_w (D, E) replicated; w1 (E, D, H) / w2 (E, H, D)
     sharded over experts on `axis_name` (E % axis_size == 0).
     """
-    n = mesh.shape[axis_name]
+    n = axis_size(mesh, axis_name)
     n_experts = w1.shape[0]
     if n_experts % n != 0:
         raise MXNetError(f"moe_ffn: {n_experts} experts not divisible by "

@@ -59,8 +59,8 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
-from ._compat import shard_map
 from .dp import DataParallelTrainer
+from .mesh import shard_map
 
 __all__ = ["ZeroTrainer", "ZeroLayout", "counters", "resolve_stage",
            "resolve_compress", "WIRE_DTYPES"]
@@ -75,10 +75,20 @@ WIRE_DTYPES = {
 
 
 def resolve_stage(value=None):
-    """ZeRO stage: explicit arg wins, else MXNET_ZERO_STAGE, else 0."""
+    """ZeRO stage: explicit arg wins, else MXNET_ZERO_STAGE, else the
+    stage a pure-zero MXNET_PLAN names (so ``MXNET_PLAN=zero2`` reroutes
+    plain trainer construction without going through the planner), else
+    0."""
     if value is None:
+        import os
         from .. import config
-        value = config.get("MXNET_ZERO_STAGE", 0)
+        # unset/empty collapses to the declared default 0; an explicit
+        # "0" is the truthy string "0" here, so it still wins over plan
+        value = os.environ.get("MXNET_ZERO_STAGE") or 0
+        if not value:
+            plan = str(config.get("MXNET_PLAN", "auto")).strip().lower()
+            if plan in ("zero1", "zero2"):
+                return int(plan[-1])
     try:
         stage = int(value)
     except (TypeError, ValueError):
@@ -307,6 +317,21 @@ class ZeroTrainer(DataParallelTrainer):
                             else WIRE_DTYPES[self._compress])
         self._n_dev = int(self._mesh.devices.size)
         self._n_outputs = len(symbol.list_outputs())
+        # N-D meshes (the planner's dp×tp+ZeRO composition): masters,
+        # optimizer state and the gather/scatter collectives shard JOINTLY
+        # over every mesh axis — 1/(D·T) per device — while the batch
+        # stays sharded over the data axis only, so the T model replicas
+        # of a data rank compute identical forwards/grads and the joint
+        # reduce needs a 1/T rescale (docs/PLANNER.md "ZeRO over dp×tp").
+        # A 1-D mesh keeps the scalar axis spelling so its programs stay
+        # bit-identical to the single-mode trainer.
+        axis_names = tuple(self._mesh.axis_names)
+        self._shard_axes = (self._data_axis if len(axis_names) == 1
+                            else axis_names)
+        self._axis_sizes = tuple(int(self._mesh.shape[a])
+                                 for a in axis_names)
+        self._model_factor = (self._n_dev
+                              // int(self._mesh.shape[self._data_axis]))
         self._layout = None
         self._resid_dev = ()
         self._zstep = None
@@ -320,7 +345,11 @@ class ZeroTrainer(DataParallelTrainer):
         # distinct jit names per config: the post-SPMD dump is matched
         # by module substring, and no tag may be a prefix of another
         suffix = {"none": "n", "bf16": "b16", "fp8": "f8"}[self._compress]
-        self._program_tag = f"zstep_s{stage}{suffix}"
+        if self._model_factor > 1:
+            self._program_tag = \
+                f"zstep_t{self._model_factor}s{stage}{suffix}"
+        else:
+            self._program_tag = f"zstep_s{stage}{suffix}"
         _ensure_hook()
 
     # -- layout / sharded placement ------------------------------------------
@@ -330,9 +359,9 @@ class ZeroTrainer(DataParallelTrainer):
             self._layout = ZeroLayout(shapes, self._n_dev,
                                       self._bucket_bytes)
             from jax.sharding import NamedSharding, PartitionSpec as P
-            self._zshard = NamedSharding(self._mesh, P(self._data_axis))
+            self._zshard = NamedSharding(self._mesh, P(self._shard_axes))
             self._rshard = NamedSharding(self._mesh,
-                                         P(self._data_axis, None))
+                                         P(self._shard_axes, None))
         return self._layout
 
     def _pack_from_host(self, host_params, host_states):
@@ -380,6 +409,16 @@ class ZeroTrainer(DataParallelTrainer):
         from ..ops.registry import AttrDict, OpCtx
         L = self._layout
         ax = self._data_axis
+        # joint shard axes: scalar data axis on a 1-D mesh (bit-identical
+        # legacy program), the full axis tuple on the planner's N-D
+        # meshes. model replicas (non-data axes) compute identical grads,
+        # so the joint psum over-counts by T — the 1/T rescale below is
+        # EXACT for power-of-two T (an fp32 exponent decrement).
+        axes = self._shard_axes
+        axis_names = tuple(self._mesh.axis_names)
+        axis_sizes = self._axis_sizes
+        model_scale = (1.0 / self._model_factor
+                       if self._model_factor > 1 else None)
         stage = self._zero_stage
         wire_dt = self._wire_dtype
         run, n_args = self._run, len(self._arg_names)
@@ -407,7 +446,7 @@ class ZeroTrainer(DataParallelTrainer):
                 m = masters[b]
                 if compute_dtype is not None:
                     m = m.astype(compute_dtype)
-                full = jax.lax.all_gather(m, ax, tiled=True)
+                full = jax.lax.all_gather(m, axes, tiled=True)
                 for i, arr in L.unflatten_traced(full, b):
                     cparams[i] = arr
             cparams = tuple(cparams)
@@ -449,6 +488,8 @@ class ZeroTrainer(DataParallelTrainer):
             finite = jnp.asarray(True)
             for b in range(B):
                 g = L.flatten_traced([grads[i] for i in L.buckets[b]], b)
+                if model_scale is not None:
+                    g = g * jnp.asarray(model_scale, g.dtype)
                 if wire_dt is not None:
                     r = resid[b][0]                 # (padded,) local f32
                     acc = g.astype(jnp.float32) + r
@@ -456,11 +497,15 @@ class ZeroTrainer(DataParallelTrainer):
                     new_resid.append(acc - c.astype(jnp.float32))
                     g = c
                 if stage >= 2:
-                    gs = jax.lax.psum_scatter(g, ax, scatter_dimension=0,
+                    gs = jax.lax.psum_scatter(g, axes, scatter_dimension=0,
                                               tiled=True)
                 else:
-                    gfull = jax.lax.psum(g, ax)
-                    k = jax.lax.axis_index(ax)
+                    gfull = jax.lax.psum(g, axes)
+                    # joint linear rank in P(axes) tiling order (row-major
+                    # over the mesh axes; == axis_index(ax) on 1-D)
+                    k = jax.lax.axis_index(axis_names[0])
+                    for a, s in zip(axis_names[1:], axis_sizes[1:]):
+                        k = k * s + jax.lax.axis_index(a)
                     gs = jax.lax.dynamic_slice_in_dim(
                         gfull, k * L.shard_len[b], L.shard_len[b])
                 g32 = gs.astype(jnp.float32)
@@ -473,7 +518,7 @@ class ZeroTrainer(DataParallelTrainer):
                 # stage-2 shards are distinct per device: the skip
                 # decision must be GLOBAL or replicas diverge
                 bad = jax.lax.psum(
-                    jnp.where(finite, 0, 1).astype(jnp.float32), ax)
+                    jnp.where(finite, 0, 1).astype(jnp.float32), axes)
                 finite = bad == 0
                 t = t + jnp.where(finite, 1.0, 0.0)
                 inv_scale = 1.0 / scale
@@ -536,10 +581,11 @@ class ZeroTrainer(DataParallelTrainer):
     def _zero_specs(self, stacked=False):
         from jax.sharding import PartitionSpec as P
         ax = self._data_axis
+        axes = self._shard_axes      # joint masters/state/resid sharding
         ispec = P(None, ax) if stacked else P(ax)
-        in_specs = (P(ax), P(ax), P(ax, None), P(), ispec,
+        in_specs = (P(axes), P(axes), P(axes, None), P(), ispec,
                     P(), P(), P())
-        out_core = (P(ax), P(ax), P(ax, None), P())
+        out_core = (P(axes), P(axes), P(axes, None), P())
         return in_specs, out_core
 
     def _build_zero_step(self):

@@ -67,6 +67,9 @@ case "$stage" in
     echo "== decode smoke (continuous batching: 8 staggered sessions, bit-identical, faster than sequential)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.serving.decode --selftest
+    echo "== planner smoke (determinism, HBM pruning, degenerate parity, ZeRO-over-dp×tp)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.parallel.planner --selftest
     echo "== static analysis (tracelint/locklint/commlint/leaklint/configlint/hloaudit, --strict gate)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.analysis --strict ;;
