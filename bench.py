@@ -1085,25 +1085,28 @@ def _dlrm_lane():
 
 
 def _dist_recovery_lane():
-    """Distributed-runtime recovery (mxnet_tpu.cluster, ISSUE 12): a real
-    2-process jax.distributed gang on the Gloo CPU backend — barrier
-    latency, then an injected SIGKILL pre-barrier timed from victim
-    death to the survivor's DistRankFailure exit (detect_s), then a kill
-    mid-cooperative-commit with a supervised restart resuming from the
-    last sealed checkpoint (mttr_s). Runs `python -m mxnet_tpu.cluster
-    --bench` in a fresh subprocess: each rank needs its own 1-device
-    backend pinned before jax initializes, and this process already
-    consumed an 8-device mesh."""
+    """Distributed-runtime recovery (mxnet_tpu.cluster, ISSUEs 12/20): a
+    real 3-process jax.distributed gang on the Gloo CPU backend —
+    barrier latency, an injected SIGKILL pre-barrier timed from victim
+    death to the survivors' DistRankFailure exits (detect_s, partial-
+    gang survival at N=3), then a kill mid-cooperative-commit healed by
+    the auto-restart SUPERVISOR with no human step: mttr_s is victim
+    death → first post-restart training step, and restarts_total /
+    shrink_events come from the supervisor's own accounting. Runs
+    `python -m mxnet_tpu.cluster --bench` in a fresh subprocess: each
+    rank needs its own 1-device backend pinned before jax initializes,
+    and this process already consumed an 8-device mesh."""
     import subprocess
     import sys
 
     env = os.environ.copy()
-    for k in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES", "MXNET_CLUSTER_INJECT"):
+    for k in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES", "MXNET_CLUSTER_INJECT",
+              "MXNET_CLUSTER_HOSTS"):
         env.pop(k, None)
     proc = subprocess.run(
         [sys.executable, "-m", "mxnet_tpu.cluster", "--bench",
-         "--nprocs", "2"],
-        capture_output=True, text=True, timeout=360, env=env,
+         "--nprocs", "3"],
+        capture_output=True, text=True, timeout=420, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -1835,10 +1838,11 @@ def main(argv=None):
     except Exception as e:
         elastic_lane = {"status": f"unavailable: {type(e).__name__}"}
     _emit("elastic_ckpt", elastic_lane)
-    # distributed-runtime recovery: 2-process gang barrier latency,
-    # injected-kill detection latency, restart-resume MTTR (ISSUE 12)
+    # distributed-runtime recovery: 3-process gang barrier latency,
+    # injected-kill detection latency, supervised self-healing MTTR +
+    # restarts_total (ISSUEs 12/20)
     try:
-        dist_lane = _gated("dist_recovery", 90, _dist_recovery_lane)
+        dist_lane = _gated("dist_recovery", 120, _dist_recovery_lane)
     except _BudgetExceeded:
         dist_lane = {"status": "skipped: budget"}
     except Exception as e:

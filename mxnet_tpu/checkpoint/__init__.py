@@ -28,9 +28,10 @@ User surface:
     python -m mxnet_tpu.checkpoint --selftest
         # crash-injection proof: SIGKILL mid-save, restore, bit-identical
 """
-from .manager import CheckpointManager
+from .manager import CheckpointManager, last_sealed_commit
 from .state import (TrainingState, capture_module_state,
                     restore_module_state, rescale_cursor, state_sha256)
 
 __all__ = ["CheckpointManager", "TrainingState", "capture_module_state",
-           "restore_module_state", "rescale_cursor", "state_sha256"]
+           "restore_module_state", "rescale_cursor", "state_sha256",
+           "last_sealed_commit"]

@@ -99,6 +99,45 @@ _SHARD_PREFIX = "shard-"
 _FORMAT = 2
 
 
+def last_sealed_commit(directory):
+    """Cheap, manager-free discovery of the newest SEALED commit under
+    `directory` — the restart point the cluster supervisor relaunches
+    from. A commit counts as sealed when its final `step-N` dir exists
+    and carries the seal file the committer wrote LAST (TOPOLOGY.json
+    for sharded format-2, MANIFEST.json for single-writer commits), so
+    a torn commit (killed mid-cooperative-commit, before the seal) is
+    never offered as a restart point. Returns {"step", "path",
+    "sealed"} for the newest such commit, or None. Presence-only by
+    design — restore() still validates checksums and falls back past
+    damaged commits on its own."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    best = None
+    for name in entries:
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        body = name[len(_STEP_PREFIX):]
+        if ".r" in body:                    # pre-elastic partial dirs
+            continue
+        try:
+            step = int(body)
+        except ValueError:
+            continue
+        path = os.path.join(directory, name)
+        seal = None
+        for fname in (_TOPOLOGY, _MANIFEST):
+            if os.path.isfile(os.path.join(path, fname)):
+                seal = fname
+                break
+        if seal is None:
+            continue
+        if best is None or step > best["step"]:
+            best = {"step": step, "path": path, "sealed": seal}
+    return best
+
+
 def _crash_requested(point, step):
     spec = os.environ.get("MXNET_CHECKPOINT_INJECT_CRASH")
     if not spec:

@@ -3,8 +3,10 @@ multi-process runtime.
 
     python -m mxnet_tpu.cluster --selftest --nprocs 2   # ci smoke (~20s)
     python -m mxnet_tpu.cluster --selftest --matrix     # full injection matrix
+    python -m mxnet_tpu.cluster --selftest --supervise  # self-healing proofs (N=3)
     python -m mxnet_tpu.cluster --bench                 # dist_recovery JSON
     python -m mxnet_tpu.cluster -n 2 [--deadline S] <cmd...>   # launch/supervise
+    python -m mxnet_tpu.cluster --supervise [--hosts h1:2,h2:2] <cmd...>
 
 Smoke phases (ci.sh quick): a 2-process barrier/collective round-trip;
 an injected SIGKILL pre-barrier whose survivor raises `DistRankFailure`
@@ -23,6 +25,16 @@ survivor turns the dead collective into `DistRankFailure`, and a rank-0
 kill pre-seal (taking the coordination service with it). Every phase
 asserts the harness deadline reaper did NOT fire — injected faults must
 end in named failures, never in the supervisor's last-resort kill.
+
+`--supervise` proves the SELF-HEALING loop at N=3, no human relaunch
+anywhere: a SIGKILLed non-zero rank and (separately) rank 0 — the
+coordinator — both end in automatic resume from the last sealed commit
+with every subsequent commit sha equal to the uninterrupted baseline;
+a repeat-offender rank triggers shrink-to-(N−1) whose smaller gang
+STILL lands on the baseline shas (the workload's global gradient is a
+fixed sum of dyadic rationals over virtual shards, so the trajectory is
+bitwise gang-size-independent); a deterministic crash loop exhausts the
+restart budget and exits 44.
 """
 from __future__ import annotations
 
@@ -33,7 +45,8 @@ import sys
 import tempfile
 import time
 
-from .launcher import ClusterLauncher, cpu_collectives_available
+from .launcher import (ClusterLauncher, cpu_collectives_available,
+                       parse_host_spec, read_hostfile)
 
 REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -41,6 +54,10 @@ REPO = os.path.dirname(os.path.dirname(
 # short fuse for the injection phases: every survivor must detect and
 # abort well inside the phase deadline
 _TIMEOUT_S = 5.0
+# even shorter under supervision: a false-positive abort self-heals (the
+# supervisor just relaunches), so the detect fuse can be tighter — this
+# is what drives mttr_s down vs the old human-relaunch measurement
+_SUP_TIMEOUT_S = 4.0
 _STEPS, _PERIOD = 12, 4         # commits at steps 4, 8, 12; faults
 _TORN_STEP = 8                  # target the 2nd commit (@2): step 8
 
@@ -170,6 +187,92 @@ dist.barrier("selftest_end")
 print(json.dumps({"evt": "final", "rank": rank, "step": steps,
                   "sha": state_sha256(snap(steps)), "ok": True,
                   "t": time.time()}), flush=True)
+"""
+
+
+_ELASTIC_WORKER = r"""
+'''Gang-size-ELASTIC deterministic trainer: the global gradient each
+step is a sum over NSHARDS fixed virtual shards (shard s belongs to
+rank s % nranks) of dyadic-rational constants k/2^14 with |k| <= 1024 —
+every partial sum is exactly representable in float32, so the cross-
+rank allreduce total is bitwise independent of how the shards are
+partitioned. The whole trajectory (and every state_sha256) is therefore
+identical at ANY gang size, which is what lets the supervisor's
+shrink-to-(N-1) restart be held to the N-rank baseline shas.'''
+import json, math, os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.state import TrainingState, state_sha256
+
+ckdir, steps, period = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+resume = len(sys.argv) > 4 and sys.argv[4] == "resume"
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+nranks = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+NSHARDS = 12
+
+kv = mx.kv.create("dist_sync")
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+names = ["w0", "w1", "w2", "w3"]
+rng = np.random.RandomState(7)
+init = {n: rng.normal(size=(16, 4)).astype(np.float32) for n in names}
+
+mgr = CheckpointManager(ckdir, sharded=True, async_save=False,
+                        keep_last_n=0, num_shards=4)
+start, vals = 0, init
+if resume:
+    st = mgr.restore()
+    if st is not None:
+        start = int(st.meta["step"])
+        vals = {n: st.arrays[f"param:{n}"] for n in names}
+        print(json.dumps({"evt": "resumed", "rank": rank, "step": start,
+                          "t": time.time()}), flush=True)
+for n in names:
+    kv.init(n, mx.nd.array(vals[n]))        # broadcasts rank 0's values
+
+def snap(step):
+    arrays = {}
+    for n in names:
+        out = mx.nd.zeros(init[n].shape)
+        kv.pull(n, out=out)
+        arrays[f"param:{n}"] = out.asnumpy()
+    return TrainingState(arrays=arrays, meta={"step": int(step)})
+
+for step in range(start + 1, steps + 1):
+    for i, n in enumerate(names):
+        g = np.float32(0.0)
+        for s in range(NSHARDS):
+            if s % nranks == rank:
+                c = round(math.cos(0.37 * step * (i + 1) + 0.11 * s)
+                          * 1024.0) / 16384.0
+                g = g + np.float32(c)      # exact: dyadic, |sum| < 1
+        kv.push(n, mx.nd.array(np.full(init[n].shape, g, np.float32)))
+    print(json.dumps({"evt": "step", "rank": rank, "step": step,
+                      "t": time.time()}), flush=True)
+    if step % period == 0:
+        st = snap(step)
+        mgr.save(st, step)
+        if rank == 0:
+            print(json.dumps({"evt": "commit", "step": step,
+                              "sha": state_sha256(st),
+                              "t": time.time()}), flush=True)
+
+dist.barrier("selftest_end")
+print(json.dumps({"evt": "final", "rank": rank, "step": steps,
+                  "sha": state_sha256(snap(steps)), "ok": True,
+                  "t": time.time()}), flush=True)
+"""
+
+
+_CRASH_WORKER = r"""
+'''Deterministic crash-loop: exits nonzero immediately, every time — no
+restart can help, no checkpoint ever seals. The supervisor must burn
+its budget and give up with exit 44, never loop forever.'''
+import os
+print("crash_worker: failing deterministically", flush=True)
+os._exit(3)
 """
 
 
@@ -460,9 +563,195 @@ def phase_kill_pre_seal(nprocs, report, baseline_shas):
           "coordinator, resumed, sha matches baseline)")
 
 
+# -- supervised (self-healing) phases ----------------------------------------
+
+def _supervisor(nprocs, ckdir, inject=None, inject_plan=None,
+                min_nprocs=1, allow_shrink=True, max_restarts=3):
+    from .supervisor import Supervisor
+    return Supervisor(
+        source=_ELASTIC_WORKER, args=(ckdir, _STEPS, _PERIOD),
+        nprocs=nprocs, min_nprocs=min_nprocs, checkpoint_dir=ckdir,
+        inject=inject, inject_plan=inject_plan, max_restarts=max_restarts,
+        backoff_s=0.1, allow_shrink=allow_shrink,
+        launcher_kwargs=dict(deadline_s=90.0,
+                             dist_timeout_s=_SUP_TIMEOUT_S,
+                             dist_retries=0, env=_base_env()))
+
+
+def _check_healed(out, phase, shas, expect_nprocs, commit_steps):
+    """Common self-healing postconditions: the supervised run ended ok
+    with the harness reaper silent, the final gang has the expected
+    size, and every commit the final incarnation sealed matches the
+    uninterrupted baseline sha at the same step."""
+    _check(out.ok and out.exit_code == 0,
+           f"{phase}: supervised run failed: {out.describe()}")
+    _check(not any(i["deadline_fired"] for i in out.incarnations),
+           f"{phase}: the harness deadline reaper fired during a "
+           "supervised incarnation")
+    _check(out.final_nprocs == expect_nprocs,
+           f"{phase}: final gang size {out.final_nprocs}, expected "
+           f"{expect_nprocs}")
+    evs = _events(out.results[-1])
+    commits = {e["step"]: e["sha"] for e in evs if e["evt"] == "commit"}
+    _check(sorted(commits) == sorted(commit_steps),
+           f"{phase}: final incarnation sealed {sorted(commits)}, "
+           f"expected {sorted(commit_steps)}")
+    for s in commits:
+        _check(commits[s] == shas.get(s),
+               f"{phase}: commit sha at step {s} diverged from the "
+               "uninterrupted baseline — recovery broke the trajectory")
+    finals = [e for e in evs if e["evt"] == "final"]
+    _check(len(finals) == expect_nprocs
+           and len({e["sha"] for e in finals}) == 1,
+           f"{phase}: final states disagree across ranks: {finals}")
+    return evs
+
+
+def phase_supervised_baseline(nprocs, report):
+    """Uninterrupted elastic-worker run: the {step: sha} trajectory
+    every supervised recovery (including the shrunk gang) must stay
+    on."""
+    ckdir = tempfile.mkdtemp(prefix="mxnet_sup_base_")
+    res = _launcher(nprocs, deadline_s=90.0).launch_python(
+        _ELASTIC_WORKER, (ckdir, _STEPS, _PERIOD))
+    _no_reap(res, "supervised_baseline")
+    _check(res.ok, "supervised_baseline: " + res.describe()
+           + "\n" + "".join(res.tails.values())[-2000:])
+    shas = {e["step"]: e["sha"] for e in _events(res)
+            if e["evt"] == "commit"}
+    _check(sorted(shas) == [_PERIOD, _TORN_STEP, _STEPS],
+           f"supervised_baseline: commits at {sorted(shas)}")
+    print("cluster-selftest: supervised_baseline recorded "
+          f"(commits at {sorted(shas)})")
+    return shas
+
+
+def phase_supervised_recovery(nprocs, report, shas):
+    """SIGKILL a non-zero rank mid-cooperative-commit (2nd commit): the
+    supervisor must classify the kill, restart in place at N from the
+    last sealed commit with NO human step, and land back on the
+    baseline sha trajectory. This is the dist_recovery lane's mttr_s."""
+    victim = nprocs - 1
+    ckdir = tempfile.mkdtemp(prefix="mxnet_sup_rec_")
+    out = _supervisor(
+        nprocs, ckdir,
+        inject=f"kill@mid-cooperative-commit:{victim}@2").run()
+    _check(out.restarts_total == 1 and out.shrink_events == 0,
+           f"supervised_recovery: {out.describe()}, expected exactly "
+           "one restart and no shrink")
+    inc0 = out.incarnations[0]
+    _check(inc0["victim"] == victim and inc0["kind"] == "kill",
+           f"supervised_recovery: classified {inc0}, expected victim "
+           f"{victim} killed")
+    _check(inc0["decision"] == "restart" and not inc0["coordinator"],
+           f"supervised_recovery: decision {inc0['decision']}, expected "
+           "restart-in-place")
+    _check(inc0["sealed_step"] == _PERIOD,
+           f"supervised_recovery: restart point {inc0['sealed_step']}, "
+           f"expected the sealed step {_PERIOD} (torn step must never "
+           "seal)")
+    evs = _check_healed(out, "supervised_recovery", shas, nprocs,
+                        (_TORN_STEP, _STEPS))
+    resumed = [e for e in evs if e["evt"] == "resumed"]
+    _check(len(resumed) == nprocs
+           and all(e["step"] == _PERIOD for e in resumed),
+           f"supervised_recovery: ranks did not resume from step "
+           f"{_PERIOD}: {resumed}")
+    _check(out.mttr_s is not None and out.mttr_s < 30.0,
+           f"supervised_recovery: implausible mttr_s={out.mttr_s}")
+    report["mttr_s"] = round(out.mttr_s, 2)
+    report["restarts_total"] = out.restarts_total
+    report["shrink_events"] = out.shrink_events
+    print(f"cluster-selftest: supervised_recovery OK (victim {victim} "
+          f"auto-restarted, MTTR {report['mttr_s']}s)")
+
+
+def phase_supervised_coordinator(nprocs, report, shas):
+    """SIGKILL rank 0 — the coordinator — mid-commit (pre-seal): jax's
+    coordination service dies with it, so recovery MUST be a full-gang
+    restart; the supervisor classifies the victim as coordinator and
+    heals automatically onto the baseline trajectory."""
+    ckdir = tempfile.mkdtemp(prefix="mxnet_sup_coord_")
+    out = _supervisor(nprocs, ckdir, inject="kill@pre-seal:0@2").run()
+    _check(out.restarts_total == 1 and out.shrink_events == 0,
+           f"supervised_coordinator: {out.describe()}, expected exactly "
+           "one restart and no shrink")
+    inc0 = out.incarnations[0]
+    _check(inc0["victim"] == 0 and inc0["coordinator"] is True,
+           f"supervised_coordinator: classified {inc0}, expected "
+           "victim 0 flagged as coordinator")
+    _check(inc0["decision"] == "restart",
+           f"supervised_coordinator: decision {inc0['decision']}, "
+           "expected full-gang restart-in-place")
+    evs = _check_healed(out, "supervised_coordinator", shas, nprocs,
+                        (_TORN_STEP, _STEPS))
+    resumed = [e for e in evs if e["evt"] == "resumed"]
+    _check(len(resumed) == nprocs
+           and all(e["step"] == _PERIOD for e in resumed),
+           f"supervised_coordinator: ranks did not resume from step "
+           f"{_PERIOD}: {resumed}")
+    report["coordinator_mttr_s"] = (round(out.mttr_s, 2)
+                                    if out.mttr_s is not None else None)
+    print("cluster-selftest: supervised_coordinator OK (rank-0 death "
+          "healed by full-gang restart, MTTR "
+          f"{report['coordinator_mttr_s']}s)")
+
+
+def phase_supervised_shrink(nprocs, report, shas):
+    """The same rank dies twice in a row with no progress (injected at
+    the FIRST commit both incarnations): repeat offender → the
+    supervisor drops its slot and completes at N−1 — and because the
+    workload's gradient is gang-size-invariant, the shrunk gang's
+    commits still equal the N-rank baseline shas."""
+    victim = nprocs - 1
+    spec = f"kill@mid-cooperative-commit:{victim}@1"
+    ckdir = tempfile.mkdtemp(prefix="mxnet_sup_shrink_")
+    out = _supervisor(nprocs, ckdir, inject_plan={0: spec, 1: spec},
+                      min_nprocs=nprocs - 1).run()
+    decisions = [i["decision"] for i in out.incarnations]
+    _check(decisions == ["restart", "shrink", "done"],
+           f"supervised_shrink: decisions {decisions}, expected "
+           "['restart', 'shrink', 'done']")
+    _check(out.shrink_events == 1 and out.restarts_total == 2,
+           f"supervised_shrink: {out.describe()}, expected 2 restarts "
+           "incl. 1 shrink")
+    _check(out.incarnations[1]["victim"] == victim,
+           f"supervised_shrink: shrink decision named victim "
+           f"{out.incarnations[1]['victim']}, expected {victim}")
+    _check_healed(out, "supervised_shrink", shas, nprocs - 1,
+                  (_PERIOD, _TORN_STEP, _STEPS))
+    report["shrink_events"] = report.get("shrink_events", 0) \
+        + out.shrink_events
+    print(f"cluster-selftest: supervised_shrink OK (repeat offender "
+          f"rank {victim} dropped, N−1={nprocs - 1} gang landed on the "
+          "baseline shas)")
+
+
+def phase_supervised_giveup(report):
+    """A deterministic crash loop (every rank exits 3 instantly, nothing
+    ever seals) must exhaust the restart budget and end with the
+    supervisor's exit 44 — 'needs a human', not an infinite loop."""
+    from .supervisor import Supervisor, GIVEUP_EXIT
+    sup = Supervisor(source=_CRASH_WORKER, nprocs=2, max_restarts=1,
+                     backoff_s=0.05, resume_arg=None,
+                     launcher_kwargs=dict(deadline_s=30.0,
+                                          failure_grace_s=10.0,
+                                          env=_base_env()))
+    out = sup.run()
+    _check(not out.ok and out.exit_code == GIVEUP_EXIT,
+           f"supervised_giveup: {out.describe()}, expected exit "
+           f"{GIVEUP_EXIT}")
+    _check(out.gave_up and out.restarts_total == 1,
+           f"supervised_giveup: {out.describe()}, expected give-up "
+           "after exactly max_restarts=1 relaunch")
+    report["giveup_exit"] = out.exit_code
+    print("cluster-selftest: supervised_giveup OK (crash loop exited "
+          f"{GIVEUP_EXIT} after the budget)")
+
+
 # -- entry points ------------------------------------------------------------
 
-def selftest(nprocs=2, matrix=False, bench=False):
+def selftest(nprocs=2, matrix=False, bench=False, supervise=False):
     if not cpu_collectives_available():
         print(json.dumps({"metric": ("dist_recovery" if bench
                                      else "cluster_selftest"),
@@ -474,32 +763,60 @@ def selftest(nprocs=2, matrix=False, bench=False):
     report = {"metric": "dist_recovery" if bench else "cluster_selftest",
               "nprocs": nprocs}
     try:
-        phase_barrier_roundtrip(nprocs, report)
-        phase_kill_pre_barrier(nprocs, report)
-        if matrix:
-            shas = phase_baseline_shas(nprocs, report)
-            phase_restart_resume(nprocs, report, check_shas=shas)
-            phase_hang_pre_barrier(nprocs, report)
-            phase_exit_mid_step(nprocs, report)
-            phase_kill_pre_seal(nprocs, report, shas)
+        if bench:
+            # the dist_recovery lane: detection half (detect_s at N)
+            # then the self-healing half (mttr_s / restarts_total
+            # through the supervisor, partial-gang survival at N=3)
+            phase_barrier_roundtrip(nprocs, report)
+            phase_kill_pre_barrier(nprocs, report)
+            shas = phase_supervised_baseline(nprocs, report)
+            phase_supervised_recovery(nprocs, report, shas)
+        elif supervise:
+            shas = phase_supervised_baseline(nprocs, report)
+            phase_supervised_recovery(nprocs, report, shas)
+            phase_supervised_coordinator(nprocs, report, shas)
+            phase_supervised_shrink(nprocs, report, shas)
+            phase_supervised_giveup(report)
         else:
-            phase_restart_resume(nprocs, report)
+            phase_barrier_roundtrip(nprocs, report)
+            phase_kill_pre_barrier(nprocs, report)
+            if matrix:
+                shas = phase_baseline_shas(nprocs, report)
+                phase_restart_resume(nprocs, report, check_shas=shas)
+                phase_hang_pre_barrier(nprocs, report)
+                phase_exit_mid_step(nprocs, report)
+                phase_kill_pre_seal(nprocs, report, shas)
+            else:
+                phase_restart_resume(nprocs, report)
     except SelftestFailure as e:
         report.update(ok=False, error=str(e))
         print(json.dumps(report), flush=True)
         return 1
     report.update(ok=True, matrix=bool(matrix),
+                  supervise=bool(supervise),
                   elapsed_s=round(time.time() - t0, 1))
     print(json.dumps(report), flush=True)
     return 0
 
 
-def run_command(nprocs, deadline_s, command):
-    """Launch/supervise an arbitrary command across a localhost gang."""
+def run_command(nprocs, deadline_s, command, hosts=None, supervise=False,
+                checkpoint_dir=None):
+    """Launch/supervise an arbitrary command across a gang (localhost by
+    default; multi-host with a host spec). With `supervise`, the
+    self-healing restart loop wraps the launch."""
     # the launcher scrubs MXNET_CLUSTER_INJECT from rank env unless armed
     # explicitly; honor the operator's env spec on the CLI path
+    inject = os.environ.get("MXNET_CLUSTER_INJECT")
+    if supervise:
+        from .supervisor import Supervisor
+        sup = Supervisor(argv=command, nprocs=nprocs, hosts=hosts,
+                         checkpoint_dir=checkpoint_dir, inject=inject,
+                         launcher_kwargs=dict(deadline_s=deadline_s))
+        out = sup.run()
+        print(f"cluster: {out.describe()}", file=sys.stderr)
+        return out.exit_code
     launcher = ClusterLauncher(nprocs=nprocs, deadline_s=deadline_s,
-                               inject=os.environ.get("MXNET_CLUSTER_INJECT"))
+                               hosts=hosts, inject=inject)
     res = launcher.launch(command)
     print(f"cluster: {res.describe()}", file=sys.stderr)
     if res.ok:
@@ -514,21 +831,48 @@ def main(argv=None):
     ap.add_argument("--selftest", action="store_true")
     ap.add_argument("--matrix", action="store_true",
                     help="full injection matrix incl. sha-identity proofs")
+    ap.add_argument("--supervise", action="store_true",
+                    help="with --selftest: the self-healing phase battery "
+                         "(N=3); with a command: wrap the launch in the "
+                         "auto-restart supervisor")
     ap.add_argument("--bench", action="store_true",
                     help="selftest emitting the dist_recovery JSON line")
-    ap.add_argument("-n", "--nprocs", type=int,
-                    default=int(os.environ.get("MXNET_CLUSTER_NPROCS",
-                                               "2")))
+    ap.add_argument("-n", "--nprocs", type=int, default=None)
+    ap.add_argument("--hosts",
+                    help="multi-host gang spec: host1:4,host2:4 "
+                         "(default MXNET_CLUSTER_HOSTS)")
+    ap.add_argument("--hostfile",
+                    help="hostfile path (host[:slots] or 'host slots=N' "
+                         "per line)")
+    ap.add_argument("--checkpoint-dir",
+                    help="sealed-commit dir the supervisor restarts from "
+                         "(progress detection for the restart budget)")
     ap.add_argument("--deadline", type=float, default=120.0,
                     help="wall-clock budget for launched commands")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+    hosts = None
+    if args.hostfile:
+        hosts = read_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = parse_host_spec(args.hosts)
+    try:
+        env_nprocs = int(os.environ.get("MXNET_CLUSTER_NPROCS", "2"))
+    except ValueError:
+        env_nprocs = 2
     if args.selftest or args.bench:
-        return selftest(nprocs=max(2, args.nprocs), matrix=args.matrix,
-                        bench=args.bench)
+        n = args.nprocs or env_nprocs
+        # partial-gang survival (shrink, N-1 >= 2) needs at least 3
+        n = max(3, n) if (args.supervise or args.bench) else max(2, n)
+        return selftest(nprocs=n, matrix=args.matrix, bench=args.bench,
+                        supervise=args.supervise)
     if not args.command:
         ap.error("no command given (or pass --selftest)")
-    return run_command(args.nprocs, args.deadline, args.command)
+    nprocs = args.nprocs if args.nprocs else (None if hosts
+                                              else env_nprocs)
+    return run_command(nprocs, args.deadline, args.command, hosts=hosts,
+                       supervise=args.supervise,
+                       checkpoint_dir=args.checkpoint_dir)
 
 
 if __name__ == "__main__":

@@ -24,6 +24,14 @@ last-N-seconds timeline, naming which rank went quiet first (a SIGKILLed
 or hung rank's box stops updating while the survivors keep recording
 their barrier waits — earliest last-event timestamp fingers the victim).
 
+Multi-host: a host spec (`MXNET_CLUSTER_HOSTS=host1:4,host2:4`, a
+hostfile, or `hosts=[(host, slots), ...]`) assigns ranks to hosts in
+order; non-local ranks run over ssh (`SshTransport` — BatchMode, the
+DMLC_/MXNET_/JAX_/XLA_ env contract shipped inside the remote command
+line), local ones exactly as before. Rank 0's host becomes the
+coordinator URI every rank dials. Localhost stays the default and the
+test path; the ssh plane is unit-tested against a mocked transport.
+
 Concurrency surfaces (analysis/locklint contract): each rank's log pump
 is one daemon thread appending to that rank's own deque (GIL-atomic
 appends, single writer) and to the shared stream under `_stream_lock`;
@@ -35,6 +43,7 @@ import collections
 import glob
 import json
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -44,7 +53,8 @@ import threading
 import time
 
 __all__ = ["ClusterLauncher", "ClusterResult", "RankProc", "free_port",
-           "cpu_collectives_available"]
+           "cpu_collectives_available", "parse_host_spec",
+           "read_hostfile", "LocalTransport", "SshTransport"]
 
 # analysis/locklint: RankProc.tail is a deque with exactly one writer
 # (that rank's pump thread; appends are GIL-atomic) and read-only after
@@ -58,6 +68,107 @@ def free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def parse_host_spec(spec):
+    """Parse `host1:4,host2:4` (or bare `host1,host2` — one slot each)
+    into an ordered [(host, slots), ...]. Ranks fill hosts in order:
+    host1 gets ranks 0..3, host2 gets 4..7."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, slots = part.rpartition(":")
+        if sep and slots.isdigit():
+            out.append((host.strip(), int(slots)))
+        else:
+            out.append((part, 1))
+    for host, slots in out:
+        if not host or slots < 1:
+            raise ValueError(f"bad host spec entry {host!r}:{slots}")
+    if not out:
+        raise ValueError(f"empty host spec {spec!r}")
+    return out
+
+
+def read_hostfile(path):
+    """Parse an MPI-style hostfile into [(host, slots), ...]. Accepted
+    line forms: `host`, `host:4`, `host slots=4`; `#` comments and
+    blank lines are skipped."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            host, slots = fields[0], 1
+            for tok in fields[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok[len("slots="):])
+            if ":" in host:
+                head, _, tail = host.rpartition(":")
+                if tail.isdigit():
+                    host, slots = head, int(tail)
+            if not host or slots < 1:
+                raise ValueError(f"bad hostfile line {raw.strip()!r}")
+            out.append((host, slots))
+    if not out:
+        raise ValueError(f"hostfile {path} names no hosts")
+    return out
+
+
+def _is_local_host(host):
+    if host in ("localhost", "127.0.0.1", "::1", ""):
+        return True
+    name = socket.gethostname()
+    return host in (name, name.split(".")[0])
+
+
+# env the ssh transport ships to the remote rank (everything the DMLC
+# contract, the framework knobs, and the jax runtime pin live under)
+_ENV_FORWARD_PREFIXES = ("DMLC_", "MXNET_", "MXIO_", "JAX_", "XLA_")
+_ENV_FORWARD_EXACT = ("PYTHONPATH",)
+
+
+class LocalTransport:
+    """Plain Popen on this host — the default and the test path."""
+
+    def popen(self, host, argv, env, **popen_kw):
+        return subprocess.Popen(list(argv), env=env, **popen_kw)
+
+
+class SshTransport:
+    """Run a rank on a remote host over ssh, the tools/launch.py way:
+    the contract env rides inside the remote command line (`env K=V ...
+    argv`), shell-quoted, so no remote config is needed beyond
+    passwordless ssh + the same repo checkout/venv path. The local ssh
+    client process is what the launcher supervises; killing it drops
+    the connection (and with it the remote process's stdin/stdout —
+    best-effort remote teardown, same as the reference's ssh
+    launcher)."""
+
+    def __init__(self, ssh_args=()):
+        self.ssh_args = list(ssh_args)
+
+    def command(self, host, argv, env):
+        fwd = {k: v for k, v in env.items()
+               if k.startswith(_ENV_FORWARD_PREFIXES)
+               or k in _ENV_FORWARD_EXACT}
+        remote = " ".join(
+            ["env"]
+            + [f"{k}={shlex.quote(v)}" for k, v in sorted(fwd.items())]
+            + [shlex.quote(a) for a in argv])
+        return ["ssh", "-o", "BatchMode=yes",
+                "-o", "StrictHostKeyChecking=accept-new",
+                *self.ssh_args, host, remote]
+
+    def popen(self, host, argv, env, **popen_kw):
+        # the remote env travels inside the command; the local ssh
+        # client just runs under the caller's environment
+        return subprocess.Popen(self.command(host, argv, env),
+                                env=dict(os.environ), **popen_kw)
 
 
 def cpu_collectives_available():
@@ -113,13 +224,16 @@ class ClusterResult:
         """The rank whose black box stopped updating first — on a
         kill/hang injection that is the victim (survivors keep flushing
         while they wait out the dist timeout). Needs >= 2 boxes with
-        events to be meaningful."""
-        last = {r: b.get("last_event_t")
+        events to be meaningful. Ties on `last_event_t` (coarse flush
+        clocks) break toward the lowest last sequence number (`total`,
+        the count of events ever recorded — the rank that logged least
+        before the silence), then the lowest rank for determinism."""
+        last = {r: (b.get("last_event_t"), b.get("total", 0))
                 for r, b in self.blackboxes.items()
                 if b.get("last_event_t")}
         if len(last) < 2:
             return None
-        return min(last, key=last.get)
+        return min(last, key=lambda r: (last[r][0], last[r][1], r))
 
     @property
     def ok(self):
@@ -203,12 +317,34 @@ class ClusterLauncher:
     blackbox_dir : where each rank's flight recorder flushes its black
         box (default: a fresh temp dir per launcher); collected into
         `ClusterResult.blackboxes` after every launch
+    hosts : multi-host gang spec — `"host1:4,host2:4"`, `[(host,
+        slots), ...]`, or default MXNET_CLUSTER_HOSTS; ranks fill hosts
+        in order, rank 0's host is the coordinator URI, non-local hosts
+        run over `transport` (default SshTransport). When set, nprocs
+        must equal (or defaults to) the slot total. Black boxes are
+        collected from blackbox_dir as usual — remote ranks' boxes
+        appear when it is on a shared filesystem.
+    transport : transport for non-local hosts (tests pass a mock)
     """
 
     def __init__(self, nprocs=None, devices_per_rank=1, deadline_s=120.0,
                  failure_grace_s=None, dist_timeout_s=None,
                  dist_retries=None, inject=None, env=None, stream=True,
-                 tail_lines=500, python=None, blackbox_dir=None):
+                 tail_lines=500, python=None, blackbox_dir=None,
+                 hosts=None, transport=None):
+        if hosts is None:
+            hosts = os.environ.get("MXNET_CLUSTER_HOSTS") or None
+        if hosts is not None:
+            hosts = parse_host_spec(hosts) if isinstance(hosts, str) \
+                else [(str(h), int(n)) for h, n in hosts]
+            total = sum(n for _, n in hosts)
+            if nprocs is None:
+                nprocs = total
+            elif int(nprocs) != total:
+                raise ValueError(
+                    f"nprocs={nprocs} != host-spec slot total {total}")
+        self.hosts = hosts
+        self.transport = transport or SshTransport()
         if nprocs is None:
             try:
                 nprocs = int(os.environ.get("MXNET_CLUSTER_NPROCS", "2"))
@@ -237,6 +373,25 @@ class ClusterLauncher:
 
     # -- environment ---------------------------------------------------------
 
+    def rank_hosts(self):
+        """The host each rank lands on ([None] * nprocs when no host
+        spec — plain localhost gang)."""
+        if self.hosts is None:
+            return [None] * self.nprocs
+        out = []
+        for host, slots in self.hosts:
+            out.extend([host] * slots)
+        return out
+
+    def coordinator_host(self):
+        """What every rank dials for the jax coordination service: rank
+        0's host under a host spec, loopback otherwise."""
+        if self.hosts is not None:
+            host = self.hosts[0][0]
+            if not _is_local_host(host):
+                return host
+        return "127.0.0.1"
+
     def rank_env(self, rank, port):
         """The env one rank runs under: DMLC_* contract + per-rank CPU
         device pin + the Gloo CPU-collectives backend."""
@@ -244,7 +399,7 @@ class ClusterLauncher:
         env.update(self.env)
         env.update({
             "DMLC_ROLE": "worker",
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_URI": self.coordinator_host(),
             "DMLC_PS_ROOT_PORT": str(port),
             "DMLC_NUM_WORKER": str(self.nprocs),
             "DMLC_NUM_SERVER": "0",
@@ -272,6 +427,9 @@ class ClusterLauncher:
             env["MXNET_CLUSTER_INJECT"] = str(self.inject)
         else:
             env.pop("MXNET_CLUSTER_INJECT", None)
+        # gang topology is the launcher's, not the workers': a worker
+        # that itself launches a gang must not inherit this host spec
+        env.pop("MXNET_CLUSTER_HOSTS", None)
         return env
 
     # -- launch / supervise --------------------------------------------------
@@ -282,11 +440,16 @@ class ClusterLauncher:
         failure (the result carries the verdict)."""
         port = free_port()
         ranks = []
+        hosts = self.rank_hosts()
+        local = LocalTransport()
         t0 = time.monotonic()
         try:
             for r in range(self.nprocs):
-                proc = subprocess.Popen(
-                    list(argv), env=self.rank_env(r, port),
+                host = hosts[r]
+                transport = local if host is None or _is_local_host(host) \
+                    else self.transport
+                proc = transport.popen(
+                    host, list(argv), self.rank_env(r, port),
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, errors="replace",
                     start_new_session=True)     # own pgid: killpg reaps

@@ -1,0 +1,592 @@
+"""Self-healing pod supervisor — the auto-restart loop over the launcher.
+
+PRs 12/13 built world-class *detection*: a dead or wedged rank turns
+into a named `DistRankFailure` in ~5 s, every rank leaves a
+flight-recorder black box, and the launcher's triage names who went
+quiet first. But recovery was still "a human relaunches". The
+`Supervisor` closes the loop the way the reference's ps-lite tolerated
+worker death by design (the server kept state; workers rejoined): it
+wraps `ClusterLauncher` in a restart loop that, on gang failure,
+
+  1. collects the black boxes and classifies what died
+     (`classify_result`): a SIGKILL/SIGSTOP victim (transient,
+     preemption-shaped), an abrupt nonzero exit (deterministic-crash
+     candidate), or rank 0 (coordinator death — jax's coordination
+     service lives in rank 0's process and is NOT HA, so losing it
+     always costs the whole gang; the supervisor recovers it like any
+     other fault, with a full-gang restart);
+  2. decides what to do (`decide` — the decision table in
+     docs/CLUSTER.md): restart-in-place at N, shrink to N−1 when the
+     same rank keeps dying (its host slot is dropped; surviving hosts
+     only — the elastic format-2 checkpoint reshards onto the smaller
+     gang), or give up with exit `GIVEUP_EXIT` (44) when the
+     exponential-backoff restart budget (`MXNET_SUPERVISE_MAX_RESTARTS`
+     consecutive relaunches without a new sealed commit,
+     `MXNET_SUPERVISE_BACKOFF_S` base backoff) is exhausted or a
+     deterministic crash loops;
+  3. relaunches every rank from the last *sealed* checkpoint commit
+     (`checkpoint.last_sealed_commit` — the TOPOLOGY.json seal is the
+     durability line; the restarted workers get a `resume` argv token
+     and restore it themselves), and
+  4. stamps `restarts_total` / `mttr_s` / `shrink_events` into the
+     telemetry registry, the profiler counter export, the JSONL
+     steplog, and (through `--bench`) the dist_recovery bench lane.
+
+MTTR is measured from the victim's death (wall clock of the failed
+incarnation's first death) to the first post-restart training step the
+relaunched workers report (`{"evt": "step", "t": ...}` JSON lines in
+the rank tails — the cluster selftest workers and BaseModule's steplog
+both emit them); when a workload reports no step events, the relaunch
+instant is used, so the metric degrades to time-to-gang-up instead of
+lying.
+
+Progress — what resets the restart budget and the repeat-offender
+streak — is a NEW sealed checkpoint step appearing between
+incarnations. A job that keeps sealing commits between faults restarts
+forever (flaky fleet, fine); a job that cannot seal anything burns the
+budget and exits 44 so a pod scheduler can tell "needs a human" from
+"recovering".
+
+Concurrency surfaces (analysis/locklint contract): the supervisor runs
+entirely on the calling thread — every launch() is synchronous and the
+counters dict has a single writer. No locks, no threads of its own.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+from .launcher import ClusterLauncher
+
+__all__ = ["Supervisor", "SupervisorResult", "FailureInfo", "Decision",
+           "classify_result", "decide", "GIVEUP_EXIT"]
+
+# analysis/locklint: supervisor state is single-threaded by design (the
+# restart loop blocks in launch(); nothing else touches it)
+__analysis_thread_safe__ = {"Supervisor._counters"}
+
+GIVEUP_EXIT = 44        # the supervisor's "needs a human" exit status
+
+# consecutive failures of the SAME victim rank before it is treated as
+# a repeat offender (shrink) / a deterministic crash loop (give up)
+REPEAT_THRESHOLD = 2
+
+_BACKOFF_CAP_S = 30.0
+
+
+def _max_restarts(override=None):
+    if override is not None:
+        return max(0, int(override))
+    from .. import config
+    try:
+        return max(0, int(config.get("MXNET_SUPERVISE_MAX_RESTARTS")))
+    except (TypeError, ValueError):
+        return 3
+
+
+def _backoff_s(override=None):
+    if override is not None:
+        return max(0.0, float(override))
+    from .. import config
+    try:
+        return max(0.0, float(config.get("MXNET_SUPERVISE_BACKOFF_S")))
+    except (TypeError, ValueError):
+        return 1.0
+
+
+class FailureInfo:
+    """What killed one incarnation: the victim rank (black-box triage
+    first, exit records second), how it died, and whether the victim
+    was the coordinator (rank 0 — its loss takes jax's coordination
+    service with it)."""
+
+    __slots__ = ("victim", "kind", "rc", "coordinator", "detail")
+
+    def __init__(self, victim, kind, rc=None, detail=""):
+        self.victim = victim
+        self.kind = kind            # kill | hang | crash | deadline | unknown
+        self.rc = rc
+        self.coordinator = victim == 0
+        self.detail = detail
+
+    def __repr__(self):
+        coord = " coordinator" if self.coordinator else ""
+        return (f"FailureInfo(victim={self.victim}, kind={self.kind}, "
+                f"rc={self.rc}{coord})")
+
+
+def classify_result(result):
+    """Classify a failed ClusterResult into a FailureInfo.
+
+    Victim attribution order: a SINGLE non-reaped signal death (the
+    inject plane's `os._exit(41)` counts; SIGABRT does not — peers of a
+    dead coordinator abort themselves when the jax coordination service
+    vanishes, so an abort is a symptom, not a murder), then the
+    flight-recorder quiet-rank triage (the box that stopped updating
+    first — tie-broken by lowest last sequence number; the only
+    evidence for a SIGSTOP hang), then reaped ranks, then any signal
+    death or abrupt exit, then plain nonzero exits. Ranks that exited
+    `dist.RANK_FAILURE_EXIT` (43) died OF a peer's death and are never
+    the victim."""
+    from ..dist import RANK_FAILURE_EXIT
+    from .inject import EXIT_CODE
+    rcs = result.returncodes
+    reaped = set(result.reaped_ranks)
+
+    def rc_of(r):
+        return rcs[r] if r is not None and r < len(rcs) else None
+
+    if getattr(result, "deadline_fired", False):
+        victim = result.quiet_rank
+        return FailureInfo(victim, "deadline", rc_of(victim),
+                           "harness deadline reaper fired")
+    murders = [r for r, rc in enumerate(rcs)
+               if rc is not None and r not in reaped
+               and ((rc < 0 and rc != -signal.SIGABRT)
+                    or rc == EXIT_CODE)]
+    victim = murders[0] if len(murders) == 1 else None
+    if victim is None:
+        victim = result.quiet_rank
+    if victim is None and reaped:
+        victim = min(reaped)
+    if victim is None:
+        for r, rc in enumerate(rcs):
+            if rc is not None and (rc < 0 or rc == EXIT_CODE):
+                victim = r
+                break
+    if victim is None:
+        for r, rc in enumerate(rcs):
+            if rc not in (0, None, RANK_FAILURE_EXIT):
+                victim = r
+                break
+    if victim is None:
+        return FailureInfo(None, "unknown", None,
+                           "no attributable victim in exit records")
+    rc = rc_of(victim)
+    if victim in reaped:
+        kind = "hang"               # only the supervisor's SIGKILL ends
+        detail = "reaped by the launcher (wedged/SIGSTOPped)"
+    elif rc is not None and rc < 0:
+        kind = "kill"
+        detail = f"died by signal {-rc}"
+    elif rc == EXIT_CODE:
+        kind = "crash"
+        detail = f"abrupt exit {EXIT_CODE} (inject plane)"
+    else:
+        kind = "crash"
+        detail = f"exited rc={rc}"
+    return FailureInfo(victim, kind, rc, detail)
+
+
+class Decision:
+    __slots__ = ("action", "reason")
+
+    def __init__(self, action, reason):
+        self.action = action        # restart | shrink | give_up
+        self.reason = reason
+
+    def __repr__(self):
+        return f"Decision({self.action}: {self.reason})"
+
+
+def decide(info, *, nprocs, min_nprocs, consecutive_no_progress,
+           max_restarts, repeat_count, progressed, allow_shrink,
+           repeat_threshold=REPEAT_THRESHOLD):
+    """The supervisor decision table (docs/CLUSTER.md):
+
+    1. restart budget: more than `max_restarts` consecutive relaunches
+       without a new sealed commit -> give up (exit 44);
+    2. deterministic crash loop: the same rank exits nonzero
+       `repeat_threshold` times in a row with no progress -> give up
+       (a code/data bug restarts cannot fix);
+    3. repeat offender: the same rank dies `repeat_threshold` times in
+       a row (kill/hang — flaky host shape) and the gang can shrink ->
+       shrink to N−1, dropping the victim's slot;
+    4. otherwise -> restart-in-place at N (transient fault; rank-0 /
+       coordinator death lands here too — full-gang restart, because
+       jax's coordination service is not HA)."""
+    if consecutive_no_progress > max_restarts:
+        return Decision("give_up",
+                        f"restart budget exhausted: {consecutive_no_progress}"
+                        f" consecutive relaunches without a sealed commit "
+                        f"(budget {max_restarts})")
+    if (info.kind == "crash" and repeat_count >= repeat_threshold
+            and not progressed):
+        return Decision("give_up",
+                        f"deterministic crash loop: rank {info.victim} "
+                        f"exited rc={info.rc} {repeat_count}x in a row "
+                        "with no progress")
+    if (repeat_count >= repeat_threshold and allow_shrink
+            and info.victim is not None and nprocs - 1 >= min_nprocs):
+        return Decision("shrink",
+                        f"repeat offender: rank {info.victim} died "
+                        f"{repeat_count}x in a row — dropping its slot, "
+                        f"continuing at {nprocs - 1}")
+    why = ("coordinator (rank 0) death — full-gang restart, jax's "
+           "coordination service is not HA"
+           if info.coordinator else f"transient {info.kind}")
+    return Decision("restart", f"{why}; restart-in-place at {nprocs}")
+
+
+class SupervisorResult:
+    """One supervised run, end to end: per-incarnation records (victim,
+    classification, decision, sealed step), the final ClusterResult,
+    and the recovery metrics the bench lane records."""
+
+    def __init__(self):
+        self.incarnations = []      # dicts: one per launch
+        self.results = []           # the ClusterResults, same order
+        self.restarts_total = 0
+        self.shrink_events = 0
+        self.mttr_s_all = []
+        self.gave_up = None         # reason string when the budget blew
+        self.final_nprocs = None
+        self.ok = False
+        self.exit_code = 1
+
+    @property
+    def mttr_s(self):
+        return self.mttr_s_all[0] if self.mttr_s_all else None
+
+    def describe(self):
+        mttr = ("none" if self.mttr_s is None
+                else f"{self.mttr_s:.2f}s")
+        tail = f" gave_up={self.gave_up!r}" if self.gave_up else ""
+        return (f"ok={self.ok} exit={self.exit_code} "
+                f"incarnations={len(self.incarnations)} "
+                f"restarts={self.restarts_total} "
+                f"shrinks={self.shrink_events} mttr={mttr} "
+                f"final_nprocs={self.final_nprocs}{tail}")
+
+
+class Supervisor:
+    """Run a gang workload under automatic fault recovery.
+
+    Parameters
+    ----------
+    argv : command list every rank runs (or use `source`)
+    source : worker python source (written once, launched per rank)
+    args : extra argv for `source` workers
+    nprocs : initial gang size (default MXNET_CLUSTER_NPROCS)
+    min_nprocs : smallest gang the shrink path may reach (default 1)
+    checkpoint_dir : where the workload seals commits; drives both the
+        progress signal (restart budget resets on a new sealed step)
+        and the restart-point log line
+    resume_arg : argv token appended on relaunches (and on the first
+        launch when a sealed commit already exists) so workers restore;
+        None disables
+    max_restarts : consecutive no-progress relaunches before giving up
+        (default MXNET_SUPERVISE_MAX_RESTARTS, 3)
+    backoff_s : base of the exponential relaunch backoff applied after
+        no-progress failures (default MXNET_SUPERVISE_BACKOFF_S, 1.0)
+    allow_shrink : permit shrink-to-(N-1) for repeat offenders
+    hosts : multi-host spec forwarded to ClusterLauncher (string
+        "host1:4,host2:4", or [(host, slots), ...]); shrink drops the
+        victim's slot from it
+    inject : MXNET_CLUSTER_INJECT spec for incarnation 0 ONLY (the
+        injected fault must not re-arm after recovery)
+    inject_plan : dict/callable incarnation->spec overriding `inject`
+        (selftests re-injecting to prove the shrink path)
+    launcher_factory : callable(nprocs, inject, hosts) -> launcher
+        (tests substitute fakes; default builds ClusterLauncher with
+        `launcher_kwargs`)
+    launcher_kwargs : extra ClusterLauncher kwargs (deadline_s, env,
+        dist_timeout_s, ...)
+    """
+
+    def __init__(self, argv=None, source=None, args=(), nprocs=None,
+                 min_nprocs=1, checkpoint_dir=None, resume_arg="resume",
+                 max_restarts=None, backoff_s=None, allow_shrink=True,
+                 hosts=None, inject=None, inject_plan=None,
+                 launcher_factory=None, launcher_kwargs=None,
+                 progress_evt="step", stream=True):
+        if (argv is None) == (source is None):
+            raise ValueError("Supervisor needs exactly one of argv= / "
+                             "source=")
+        self._argv = list(argv) if argv else None
+        self._source = source
+        self._args = tuple(args)
+        if hosts is None:
+            # own the host spec here: shrink must be able to rewrite it,
+            # and an explicit hosts= to the launcher outranks the env
+            hosts = os.environ.get("MXNET_CLUSTER_HOSTS") or None
+        if nprocs is None and hosts is not None:
+            from .launcher import parse_host_spec
+            pairs = parse_host_spec(hosts) if isinstance(hosts, str) \
+                else hosts
+            nprocs = sum(int(n) for _, n in pairs)
+        if nprocs is None:
+            try:
+                nprocs = int(os.environ.get("MXNET_CLUSTER_NPROCS", "2"))
+            except ValueError:
+                nprocs = 2
+        self.nprocs = max(1, int(nprocs))
+        self.min_nprocs = max(1, int(min_nprocs))
+        self.checkpoint_dir = checkpoint_dir
+        self.resume_arg = resume_arg
+        self.max_restarts = _max_restarts(max_restarts)
+        self.backoff_s = _backoff_s(backoff_s)
+        self.allow_shrink = bool(allow_shrink)
+        self.hosts = hosts
+        self._inject = inject
+        self._inject_plan = inject_plan
+        self._factory = launcher_factory
+        self._launcher_kwargs = dict(launcher_kwargs or {})
+        self.progress_evt = progress_evt
+        self.stream = stream
+        self._counters = {"restarts_total": 0, "shrink_events": 0,
+                          "give_ups": 0, "mttr_s_last": 0.0,
+                          "gang_size": self.nprocs}
+        try:
+            from .. import profiler
+            profiler.register_counter_export("supervisor", self.counters)
+        except Exception:               # pragma: no cover
+            pass
+
+    # -- observability -------------------------------------------------------
+
+    def counters(self):
+        return dict(self._counters)
+
+    def _emit(self, text):
+        if self.stream:
+            sys.stdout.write(f"supervisor: {text}\n")
+            sys.stdout.flush()
+
+    def _note_metrics(self, result):
+        """Stamp the recovery metrics into the telemetry registry + the
+        JSONL steplog (never raises: recovery must not die of
+        observability)."""
+        try:
+            from ..telemetry import counter, gauge
+            counter("mxnet_supervisor_restarts_total",
+                    help="gang relaunches performed by the cluster "
+                         "supervisor")
+            # counters are cumulative: re-sync to the result totals
+            gauge("mxnet_supervisor_gang_size",
+                  help="current supervised gang size").set(
+                result.final_nprocs or self.nprocs)
+            if result.mttr_s_all:
+                gauge("mxnet_supervisor_mttr_seconds",
+                      help="last measured mean-time-to-recovery: victim "
+                           "death to first post-restart step").set(
+                    result.mttr_s_all[-1])
+        except Exception:               # pragma: no cover
+            pass
+        try:
+            from ..telemetry.steplog import log_event
+            log_event("supervisor_recovery",
+                      restarts_total=result.restarts_total,
+                      shrink_events=result.shrink_events,
+                      mttr_s=result.mttr_s,
+                      gave_up=bool(result.gave_up),
+                      final_nprocs=result.final_nprocs)
+        except Exception:               # pragma: no cover
+            pass
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _inject_for(self, incarnation):
+        plan = self._inject_plan
+        if callable(plan):
+            return plan(incarnation)
+        if isinstance(plan, dict):
+            return plan.get(incarnation)
+        if isinstance(plan, (list, tuple)):
+            return plan[incarnation] if incarnation < len(plan) else None
+        return self._inject if incarnation == 0 else None
+
+    def _make_launcher(self, nprocs, inject, hosts):
+        if self._factory is not None:
+            return self._factory(nprocs, inject, hosts)
+        kw = dict(self._launcher_kwargs)
+        kw.update(nprocs=nprocs, inject=inject)
+        if hosts is not None:
+            kw["hosts"] = hosts
+        kw.setdefault("stream", self.stream)
+        return ClusterLauncher(**kw)
+
+    def _sealed_step(self):
+        if not self.checkpoint_dir:
+            return None
+        try:
+            from ..checkpoint import last_sealed_commit
+            info = last_sealed_commit(self.checkpoint_dir)
+            return None if info is None else info["step"]
+        except Exception:               # pragma: no cover
+            return None
+
+    def _base_argv(self):
+        if self._argv is not None:
+            return list(self._argv)
+        # write the worker source ONCE; every incarnation reuses the path
+        wd = tempfile.mkdtemp(prefix="mxnet_supervise_")
+        script = os.path.join(wd, "supervised_worker.py")
+        with open(script, "w", encoding="utf-8") as f:
+            f.write(self._source)
+        return [sys.executable, script, *map(str, self._args)]
+
+    def _first_progress_t(self, result):
+        """Earliest wall timestamp of a progress (`step`) event any rank
+        printed — the recovery instant MTTR ends at."""
+        best = None
+        for text in result.tails.values():
+            for line in text.splitlines():
+                line = line.strip()
+                if not (line.startswith("{") and '"evt"' in line):
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if d.get("evt") == self.progress_evt and "t" in d:
+                    t = float(d["t"])
+                    if best is None or t < best:
+                        best = t
+        return best
+
+    @staticmethod
+    def _shrink_hosts(hosts, victim, nprocs):
+        """Drop the victim rank's slot from a host spec (ranks fill
+        hosts in order). None spec (localhost) stays None — the gang
+        just shrinks."""
+        if hosts is None:
+            return None
+        from .launcher import parse_host_spec
+        pairs = parse_host_spec(hosts) if isinstance(hosts, str) \
+            else [(h, int(n)) for h, n in hosts]
+        out, rank = [], 0
+        for host, slots in pairs:
+            keep = slots
+            if rank <= victim < rank + slots:
+                keep = slots - 1
+            if keep > 0:
+                out.append((host, keep))
+            rank += slots
+        return out or None
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self):
+        """Supervise to completion. Returns a SupervisorResult; never
+        raises on workload failure (the result carries the verdict)."""
+        out = SupervisorResult()
+        base_argv = self._base_argv()
+        nprocs, hosts = self.nprocs, self.hosts
+        incarnation = 0
+        consecutive_no_progress = 0
+        repeat_count, last_victim = 0, None
+        pending_death_wall = None
+        sealed_before = self._sealed_step()
+        while True:
+            argv = list(base_argv)
+            if self.resume_arg and (incarnation > 0
+                                    or sealed_before is not None):
+                argv.append(self.resume_arg)
+            inject = self._inject_for(incarnation)
+            launcher = self._make_launcher(nprocs, inject, hosts)
+            self._emit(f"incarnation {incarnation}: launching {nprocs} "
+                       f"rank(s)"
+                       + (f" from sealed step {sealed_before}"
+                          if sealed_before is not None else " fresh")
+                       + (f" [inject={inject}]" if inject else ""))
+            launch_wall = time.time()
+            res = launcher.launch(argv)
+            out.results.append(res)
+            self._counters["gang_size"] = nprocs
+            if pending_death_wall is not None:
+                t_rec = self._first_progress_t(res) or launch_wall
+                mttr = max(0.0, t_rec - pending_death_wall)
+                out.mttr_s_all.append(round(mttr, 3))
+                self._counters["mttr_s_last"] = round(mttr, 3)
+                self._emit(f"recovered: MTTR {mttr:.2f}s (death -> first "
+                           "post-restart step)")
+                pending_death_wall = None
+            rec = {"incarnation": incarnation, "nprocs": nprocs,
+                   "ok": res.ok, "deadline_fired": res.deadline_fired,
+                   "returncodes": list(res.returncodes),
+                   "sealed_step": sealed_before}
+            if res.ok:
+                rec.update(decision="done", victim=None)
+                out.incarnations.append(rec)
+                out.ok = True
+                out.exit_code = 0
+                break
+            info = classify_result(res)
+            sealed_now = self._sealed_step()
+            progressed = (sealed_now is not None
+                          and (sealed_before is None
+                               or sealed_now > sealed_before))
+            sealed_before = sealed_now
+            if progressed:
+                consecutive_no_progress = 1
+            else:
+                consecutive_no_progress += 1
+            if info.victim is not None and info.victim == last_victim:
+                repeat_count += 1
+            else:
+                repeat_count = 1
+            last_victim = info.victim
+            decision = decide(
+                info, nprocs=nprocs, min_nprocs=self.min_nprocs,
+                consecutive_no_progress=consecutive_no_progress,
+                max_restarts=self.max_restarts,
+                repeat_count=repeat_count, progressed=progressed,
+                allow_shrink=self.allow_shrink)
+            rec.update(victim=info.victim, kind=info.kind,
+                       coordinator=info.coordinator, detail=info.detail,
+                       decision=decision.action, reason=decision.reason,
+                       progressed=progressed,
+                       sealed_step=sealed_now)
+            out.incarnations.append(rec)
+            self._emit(f"incarnation {incarnation} failed: {info!r} — "
+                       f"{decision.action} ({decision.reason})")
+            if decision.action == "give_up":
+                out.gave_up = decision.reason
+                out.ok = False
+                out.exit_code = GIVEUP_EXIT
+                self._counters["give_ups"] += 1
+                break
+            if decision.action == "shrink":
+                hosts = self._shrink_hosts(hosts, info.victim, nprocs)
+                nprocs -= 1
+                out.shrink_events += 1
+                self._counters["shrink_events"] += 1
+                try:
+                    from ..telemetry import counter
+                    counter("mxnet_supervisor_shrink_events_total",
+                            help="gang shrink-to-(N-1) recoveries").inc()
+                except Exception:           # pragma: no cover
+                    pass
+            death_s = res.first_death_s if res.first_death_s is not None \
+                else res.elapsed_s
+            pending_death_wall = launch_wall + death_s
+            out.restarts_total += 1
+            self._counters["restarts_total"] += 1
+            try:
+                from ..telemetry import counter
+                counter("mxnet_supervisor_restarts_total",
+                        help="gang relaunches performed by the cluster "
+                             "supervisor").inc()
+            except Exception:               # pragma: no cover
+                pass
+            if not progressed and consecutive_no_progress > 1:
+                delay = min(_BACKOFF_CAP_S, self.backoff_s
+                            * (2 ** (consecutive_no_progress - 2)))
+                if delay > 0:
+                    self._emit(f"backing off {delay:.2f}s before "
+                               "relaunch (no progress)")
+                    time.sleep(delay)
+            incarnation += 1
+        out.final_nprocs = nprocs
+        if not out.ok and out.exit_code != GIVEUP_EXIT:
+            out.exit_code = next(
+                (rc for rc in out.results[-1].returncodes
+                 if rc not in (0, None)), 1)
+        self._note_metrics(out)
+        self._emit(out.describe())
+        return out

@@ -173,6 +173,18 @@ _DOCUMENTED = {
     "MXNET_DIST_RETRIES": 1,
     "MXNET_CLUSTER_NPROCS": 2,
     "MXNET_CLUSTER_INJECT": None,
+    # self-healing supervisor + multi-host gangs (cluster/supervisor.py,
+    # cluster/launcher.py, docs/CLUSTER.md): MXNET_CLUSTER_HOSTS=
+    # host1:4,host2:4 assigns ranks to hosts in order (non-local hosts
+    # run over ssh; rank 0's host is the coordinator);
+    # MXNET_SUPERVISE_MAX_RESTARTS bounds consecutive gang relaunches
+    # without a new sealed checkpoint commit before the supervisor gives
+    # up with exit 44; MXNET_SUPERVISE_BACKOFF_S (float-string seconds)
+    # is the base of the exponential backoff between no-progress
+    # relaunches
+    "MXNET_CLUSTER_HOSTS": None,
+    "MXNET_SUPERVISE_MAX_RESTARTS": 3,
+    "MXNET_SUPERVISE_BACKOFF_S": "1",
     # distributed span tracing (telemetry/tracing.py, docs/TELEMETRY.md):
     # MXNET_TRACE=1 records host-side phase spans (feed/compute/comm/
     # ckpt/serve) into the shared profiler event ring and writes this

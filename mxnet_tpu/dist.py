@@ -67,10 +67,16 @@ class DistRankFailure(MXNetError):
     names the ranks that never arrived when the coordination service
     could tell; all-thread stacks were dumped before raising."""
 
-    def __init__(self, message, barrier=None, missing_ranks=()):
+    def __init__(self, message, barrier=None, missing_ranks=(),
+                 coordinator=False):
         super().__init__(message)
         self.barrier = barrier
         self.missing_ranks = tuple(missing_ranks)
+        # True when the failure shape says the coordination service
+        # itself is gone (it lives in rank 0's process and is not HA):
+        # recovery needs a full-gang restart, not a peer rejoin — the
+        # cluster supervisor keys off this
+        self.coordinator = bool(coordinator)
 
 
 def is_initialized():
@@ -274,7 +280,7 @@ def _log_event(event, **fields):
         pass
 
 
-def _fail(what, missing, reason, elapsed_s):
+def _fail(what, missing, reason, elapsed_s, coordinator=False):
     """The one exit ramp for a dead rendezvous: coordinated abort key,
     all-thread stack dump, flight-recorder + trace-shard black boxes,
     failure counter, JSONL record, raise."""
@@ -297,31 +303,35 @@ def _fail(what, missing, reason, elapsed_s):
     c_fail.inc()
     _log_event("dist_rank_failure", what=what,
                missing_ranks=list(missing), reason=str(reason)[:300],
+               coordinator=bool(coordinator),
                elapsed_s=round(elapsed_s, 3))
     named = (f" — missing rank(s): {', '.join(map(str, missing))}"
              if missing else "")
     raise DistRankFailure(
         f"distributed {what} failed after {elapsed_s:.1f}s: "
-        f"{reason}{named}", barrier=what, missing_ranks=missing)
+        f"{reason}{named}", barrier=what, missing_ranks=missing,
+        coordinator=coordinator)
 
 
 def _classify(exc):
-    """(is_rank_failure, missing, reason) for a collective/barrier
-    exception."""
+    """(is_rank_failure, missing, reason, coordinator) for a
+    collective/barrier exception. `coordinator` marks the failure shape
+    where the coordination service itself (rank 0's process) is gone."""
     txt = str(exc)
     first = txt.splitlines()[0][:300] if txt else repr(exc)
     if "DEADLINE_EXCEEDED" in txt or "Barrier timed out" in txt:
-        return True, _parse_missing(txt), first
+        return True, _parse_missing(txt), first, False
     low = txt.lower()
     if "connection closed by peer" in low:      # Gloo mid-collective
-        return True, [], f"peer socket closed mid-collective ({first})"
+        return True, [], f"peer socket closed mid-collective ({first})", \
+            False
     if ("UNAVAILABLE" in txt or "failed to connect" in low
             or "connection reset" in low
             or "Connection refused" in txt):
         # the coordination service lives in rank 0's process: losing the
         # channel usually means rank 0 itself is gone
-        return True, [], f"coordinator unreachable ({first})"
-    return False, [], first
+        return True, [], f"coordinator unreachable ({first})", True
+    return False, [], first, False
 
 
 def _run_guarded(fn, what, timeout_s):
@@ -376,9 +386,9 @@ def _wait_guarded(fn, what, timeout_s):
         e = box["error"]
         if isinstance(e, DistRankFailure):
             raise e
-        is_rank, missing, reason = _classify(e)
+        is_rank, missing, reason, coord = _classify(e)
         if is_rank:
-            _fail(what, missing, reason, elapsed)
+            _fail(what, missing, reason, elapsed, coordinator=coord)
         raise e
     c_wait, _ = _metrics()
     c_wait.inc(int(elapsed * 1e6))

@@ -100,6 +100,13 @@ _QUICK = {
     "test_decode.py::test_decode_matches_full_context_recompute",
     "test_decode.py::test_pool_full_admission_is_sized_507",
     "test_decode.py::test_quantized_matmul_matches_dequant_then_matmul",
+    "test_supervisor.py::test_decide_transient_restarts_in_place",
+    "test_supervisor.py::test_decide_crash_loop_gives_up",
+    "test_supervisor.py::test_run_repeat_offender_shrinks_then_finishes",
+    "test_supervisor.py::test_run_budget_exhaustion_gives_up_44",
+    "test_supervisor.py::test_parse_host_spec_round_trip",
+    "test_supervisor.py::test_ssh_transport_command_env_contract",
+    "test_cluster.py::test_quiet_rank_tie_breaks_on_last_sequence_number",
     "test_analysis.py::test_repo_is_clean_under_strict",
     "test_analysis.py::test_amp_wire_invariant_via_auditor",
     "test_analysis.py::test_tracelint_item_sync_in_scanned_step",

@@ -94,6 +94,53 @@ def test_maybe_inject_nth_hit_counting(monkeypatch):
     inject.reset_counters()
 
 
+# -- black-box triage: quiet-rank attribution --------------------------------
+
+def _result_with_boxes(boxes, nranks=3):
+    from mxnet_tpu.cluster.launcher import ClusterResult
+
+    class _R:
+        def __init__(self, rank):
+            self.rank, self.exit_rc = rank, 0
+            self.exit_t, self.reaped = None, False
+
+        def log_text(self):
+            return ""
+
+    return ClusterResult([_R(r) for r in range(nranks)], elapsed_s=1.0,
+                         deadline_fired=False, first_death_t=None,
+                         t0=0.0, blackboxes=boxes)
+
+
+def test_quiet_rank_picks_oldest_box():
+    res = _result_with_boxes({
+        0: {"last_event_t": 100.0, "total": 50},
+        1: {"last_event_t": 94.0, "total": 48},   # went quiet first
+        2: {"last_event_t": 99.5, "total": 51},
+    })
+    assert res.quiet_rank == 1
+
+
+def test_quiet_rank_tie_breaks_on_last_sequence_number():
+    # coarse flush clocks collide: the rank that logged LEAST before the
+    # silence is the victim, not the lowest rank number
+    res = _result_with_boxes({
+        0: {"last_event_t": 100.0, "total": 57},
+        1: {"last_event_t": 100.0, "total": 31},
+        2: {"last_event_t": 105.0, "total": 60},
+    })
+    assert res.quiet_rank == 1
+    # full tie (same clock, same seq): lowest rank, deterministically
+    res = _result_with_boxes({
+        0: {"last_event_t": 100.0, "total": 40},
+        2: {"last_event_t": 100.0, "total": 40},
+    })
+    assert res.quiet_rank == 0
+    # fewer than 2 boxes with events: no attribution
+    assert _result_with_boxes({0: {"last_event_t": 1.0}}).quiet_rank \
+        is None
+
+
 # -- launcher supervision (no jax in the workers: pure process control) ------
 
 def _quick(nprocs=2, **kw):

@@ -1,0 +1,448 @@
+"""mxnet_tpu.cluster.supervisor — the self-healing restart loop.
+
+Quick tier: the decision table (`decide`), failure classification over
+stub ClusterResults (`classify_result`), restart-budget exhaustion,
+host-spec/hostfile parsing round-trips, the ssh transport's assembled
+command line (mocked — no ssh runs), `_shrink_hosts` slot dropping,
+`last_sealed_commit` discovery, and full `Supervisor.run()` flows
+driven by a scripted fake launcher — all in-process, sub-second.
+
+Slow tier (-m slow, needs the Gloo CPU collectives backend): a real
+3-process gang under the supervisor proving kill -> shrink-to-2 ->
+resume with `state_sha256` equal to the uninterrupted baseline (the
+same property `--selftest --supervise` checks in CI).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+from mxnet_tpu.checkpoint import last_sealed_commit
+from mxnet_tpu.cluster import (cpu_collectives_available, parse_host_spec,
+                               read_hostfile)
+from mxnet_tpu.cluster.launcher import SshTransport, _is_local_host
+from mxnet_tpu.cluster.supervisor import (GIVEUP_EXIT, FailureInfo,
+                                          Supervisor, classify_result,
+                                          decide)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_gloo = pytest.mark.skipif(
+    not cpu_collectives_available(),
+    reason="jaxlib lacks the Gloo CPU cross-process collectives backend")
+
+
+# -- host-spec / hostfile parsing --------------------------------------------
+
+def test_parse_host_spec_round_trip():
+    assert parse_host_spec("host1:4,host2:4") == [("host1", 4),
+                                                  ("host2", 4)]
+    assert parse_host_spec("a, b:2 ,c") == [("a", 1), ("b", 2), ("c", 1)]
+    assert parse_host_spec("tpu-vm-0:8") == [("tpu-vm-0", 8)]
+
+
+@pytest.mark.parametrize("bad", ["", "  ,  ", ":4", "h:0"])
+def test_parse_host_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_host_spec(bad)
+
+
+def test_read_hostfile_forms(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text(
+        "# the pod\n"
+        "host1:4\n"
+        "host2 slots=4   # trailing comment\n"
+        "\n"
+        "host3\n")
+    assert read_hostfile(str(hf)) == [("host1", 4), ("host2", 4),
+                                      ("host3", 1)]
+
+
+def test_read_hostfile_rejects_empty_and_bad(tmp_path):
+    empty = tmp_path / "empty"
+    empty.write_text("# nothing but comments\n\n")
+    with pytest.raises(ValueError):
+        read_hostfile(str(empty))
+    bad = tmp_path / "bad"
+    bad.write_text("host1 slots=0\n")
+    with pytest.raises(ValueError):
+        read_hostfile(str(bad))
+
+
+def test_is_local_host():
+    import socket
+    assert _is_local_host("localhost")
+    assert _is_local_host("127.0.0.1")
+    assert _is_local_host(socket.gethostname())
+    assert not _is_local_host("tpu-vm-7")
+
+
+def test_hosts_env_round_trip_through_launcher(monkeypatch):
+    from mxnet_tpu.cluster import ClusterLauncher
+    monkeypatch.setenv("MXNET_CLUSTER_HOSTS", "localhost:2,localhost:1")
+    cl = ClusterLauncher(stream=False)
+    assert cl.nprocs == 3
+    assert cl.rank_hosts() == ["localhost", "localhost", "localhost"]
+    # slot total must agree with an explicit nprocs
+    with pytest.raises(ValueError):
+        ClusterLauncher(nprocs=2, stream=False)
+    # workers must NOT inherit the gang topology (nested launches)
+    env = cl.rank_env(0, 5555)
+    assert "MXNET_CLUSTER_HOSTS" not in env
+    assert env["DMLC_PS_ROOT_URI"] == "127.0.0.1"   # local spec
+
+
+# -- ssh transport: assembled command line, no ssh ever runs -----------------
+
+def test_ssh_transport_command_env_contract():
+    t = SshTransport(ssh_args=["-p", "2222"])
+    env = {"DMLC_WORKER_ID": "3", "DMLC_NUM_WORKER": "8",
+           "MXNET_DIST_TIMEOUT_S": "5.0", "PYTHONPATH": "/opt/repo",
+           "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--flag=1 --two",
+           "HOME": "/root", "PATH": "/usr/bin", "SECRET_TOKEN": "x"}
+    cmd = t.command("host2", [sys.executable, "train.py", "a b"], env)
+    assert cmd[0] == "ssh"
+    assert "BatchMode=yes" in cmd
+    assert "StrictHostKeyChecking=accept-new" in cmd
+    assert cmd[cmd.index("-p") + 1] == "2222"
+    assert cmd[-2] == "host2"
+    remote = cmd[-1]
+    # contract env rides inside the remote command, quoted
+    assert remote.startswith("env ")
+    assert "DMLC_WORKER_ID=3" in remote
+    assert "'--flag=1 --two'" in remote
+    assert "'a b'" in remote
+    # only the contract prefixes + PYTHONPATH are forwarded
+    assert "PYTHONPATH=/opt/repo" in remote
+    assert "HOME=" not in remote and "PATH=/usr/bin" not in remote
+    assert "SECRET_TOKEN" not in remote
+
+
+# -- failure classification over stub results --------------------------------
+
+class _FakeResult:
+    """Just the fields classify_result / Supervisor.run read."""
+
+    def __init__(self, returncodes, reaped=(), deadline=False, quiet=None,
+                 first_death_s=None, elapsed_s=1.0, tails=None):
+        self.returncodes = list(returncodes)
+        self.reaped_ranks = list(reaped)
+        self.deadline_fired = deadline
+        self.quiet_rank = quiet
+        self.first_death_s = first_death_s
+        self.elapsed_s = elapsed_s
+        self.tails = dict(tails or {})
+        self.failed_ranks = [r for r, rc in enumerate(self.returncodes)
+                             if rc not in (0, None)]
+
+    @property
+    def ok(self):
+        return (not self.deadline_fired and not self.reaped_ranks
+                and all(rc == 0 for rc in self.returncodes))
+
+    def describe(self):
+        return f"fake rcs={self.returncodes}"
+
+
+def test_classify_kill_via_exit_record():
+    info = classify_result(_FakeResult([0, -9, 43], quiet=1))
+    assert (info.victim, info.kind, info.rc) == (1, "kill", -9)
+    assert not info.coordinator
+
+
+def test_classify_coordinator_death():
+    info = classify_result(_FakeResult([-9, 43, 43], quiet=0))
+    assert info.victim == 0 and info.coordinator and info.kind == "kill"
+
+
+def test_classify_aborting_peers_are_symptoms_not_victims():
+    # rank 0 SIGKILLed; its peers die by SIGABRT when the coordination
+    # service vanishes. Flush-clock triage names the wrong rank (coarse
+    # clocks) — the single non-abort signal death must win.
+    info = classify_result(_FakeResult([-9, -6, -6], quiet=1))
+    assert info.victim == 0 and info.coordinator and info.kind == "kill"
+
+
+def test_classify_all_aborts_falls_back_to_quiet_rank():
+    # no unambiguous murder (every death is a SIGABRT): the black-box
+    # triage is the only evidence left
+    info = classify_result(_FakeResult([-6, -6, 43], quiet=1))
+    assert (info.victim, info.kind) == (1, "kill")
+
+
+def test_classify_hang_is_the_reaped_rank():
+    info = classify_result(_FakeResult([43, -9, 43], reaped=[1], quiet=None))
+    assert (info.victim, info.kind) == (1, "hang")
+
+
+def test_classify_crash_skips_peer_death_exits():
+    # rank 0 exited RANK_FAILURE_EXIT (died OF the fault, not the cause)
+    info = classify_result(_FakeResult([43, 3, 43], quiet=None))
+    assert (info.victim, info.kind, info.rc) == (1, "crash", 3)
+
+
+def test_classify_inject_exit_41_is_crash():
+    info = classify_result(_FakeResult([43, 41], quiet=None))
+    assert (info.victim, info.kind) == (1, "crash")
+
+
+def test_classify_deadline():
+    info = classify_result(_FakeResult([-9, -9], deadline=True, quiet=None))
+    assert info.kind == "deadline"
+
+
+def test_classify_no_victim():
+    info = classify_result(_FakeResult([43, 43], quiet=None))
+    assert info.victim is None and info.kind == "unknown"
+
+
+# -- the decision table -------------------------------------------------------
+
+def _decide(info, **kw):
+    base = dict(nprocs=3, min_nprocs=1, consecutive_no_progress=1,
+                max_restarts=3, repeat_count=1, progressed=True,
+                allow_shrink=True)
+    base.update(kw)
+    return decide(info, **base)
+
+
+def test_decide_transient_restarts_in_place():
+    d = _decide(FailureInfo(2, "kill", -9))
+    assert d.action == "restart" and "transient" in d.reason
+
+
+def test_decide_coordinator_death_full_gang_restart():
+    d = _decide(FailureInfo(0, "kill", -9))
+    assert d.action == "restart" and "coordinator" in d.reason
+
+
+def test_decide_repeat_offender_shrinks():
+    d = _decide(FailureInfo(2, "kill", -9), repeat_count=2,
+                progressed=False, consecutive_no_progress=2)
+    assert d.action == "shrink"
+
+
+def test_decide_shrink_respects_floor_and_opt_out():
+    info = FailureInfo(1, "kill", -9)
+    d = _decide(info, repeat_count=2, nprocs=2, min_nprocs=2)
+    assert d.action == "restart"          # can't go below the floor
+    d = _decide(info, repeat_count=2, allow_shrink=False)
+    assert d.action == "restart"
+
+
+def test_decide_crash_loop_gives_up():
+    d = _decide(FailureInfo(1, "crash", 3), repeat_count=2,
+                progressed=False, consecutive_no_progress=2)
+    assert d.action == "give_up" and "crash loop" in d.reason
+
+
+def test_decide_crash_with_progress_keeps_restarting():
+    # a crash that still seals commits is not a deterministic loop
+    d = _decide(FailureInfo(1, "crash", 3), repeat_count=2,
+                progressed=True)
+    assert d.action == "shrink"     # repeat offender path still applies
+
+
+def test_decide_budget_exhaustion_wins_over_everything():
+    d = _decide(FailureInfo(2, "kill", -9), consecutive_no_progress=4,
+                progressed=False)
+    assert d.action == "give_up" and "budget" in d.reason
+
+
+# -- shrink host bookkeeping --------------------------------------------------
+
+def test_shrink_hosts_drops_victim_slot():
+    sh = Supervisor._shrink_hosts
+    assert sh("h1:2,h2:2", 2, 4) == [("h1", 2), ("h2", 1)]
+    assert sh([("h1", 2), ("h2", 2)], 0, 4) == [("h1", 1), ("h2", 2)]
+    # last slot on a host drops the host entirely
+    assert sh("h1:2,h2:1", 2, 3) == [("h1", 2)]
+    assert sh(None, 1, 3) is None         # localhost gangs just shrink
+
+
+# -- sealed-commit discovery --------------------------------------------------
+
+def _mk_commit(root, step, seal=None, partial=False):
+    name = f"step-{step:010d}" + (".r4" if partial else "")
+    d = root / name
+    d.mkdir()
+    (d / "shard-0.bin").write_bytes(b"x")
+    if seal:
+        (d / seal).write_text("{}")
+    return d
+
+
+def test_last_sealed_commit_picks_newest_sealed(tmp_path):
+    assert last_sealed_commit(str(tmp_path)) is None
+    _mk_commit(tmp_path, 4, seal="TOPOLOGY.json")
+    _mk_commit(tmp_path, 8, seal="TOPOLOGY.json")
+    _mk_commit(tmp_path, 12)                      # torn: no seal
+    _mk_commit(tmp_path, 16, seal="TOPOLOGY.json", partial=True)  # .r dir
+    info = last_sealed_commit(str(tmp_path))
+    assert info["step"] == 8 and info["sealed"] == "TOPOLOGY.json"
+    assert info["path"].endswith("step-0000000008")
+
+
+def test_last_sealed_commit_single_writer_manifest(tmp_path):
+    _mk_commit(tmp_path, 3, seal="MANIFEST.json")
+    info = last_sealed_commit(str(tmp_path))
+    assert info["step"] == 3 and info["sealed"] == "MANIFEST.json"
+    assert last_sealed_commit(str(tmp_path / "missing")) is None
+
+
+# -- Supervisor.run() against a scripted fake launcher ------------------------
+
+class _FakeLauncher:
+    def __init__(self, result, log):
+        self._result = result
+        self._log = log
+
+    def launch(self, argv):
+        self._log[-1]["argv"] = list(argv)
+        return self._result
+
+
+def _supervised(results, tmp_path, seal_after=None, **kw):
+    """Supervisor over a script of _FakeResults; `seal_after[i]` commits
+    a sealed step after incarnation i returns (simulating workload
+    progress)."""
+    calls = []
+    script = list(results)
+
+    def factory(nprocs, inject, hosts):
+        calls.append({"nprocs": nprocs, "inject": inject, "hosts": hosts})
+        i = len(calls) - 1
+        if seal_after and seal_after.get(i) is not None:
+            _mk_commit(tmp_path, seal_after[i], seal="TOPOLOGY.json")
+        return _FakeLauncher(script[min(i, len(script) - 1)], calls)
+
+    kw.setdefault("nprocs", 3)
+    kw.setdefault("backoff_s", 0.0)
+    sup = Supervisor(argv=["worker"], checkpoint_dir=str(tmp_path),
+                     launcher_factory=factory, stream=False, **kw)
+    return sup, calls
+
+
+def test_run_clean_success_no_restarts(tmp_path):
+    sup, calls = _supervised([_FakeResult([0, 0, 0])], tmp_path)
+    out = sup.run()
+    assert out.ok and out.exit_code == 0
+    assert out.restarts_total == 0 and out.shrink_events == 0
+    assert out.mttr_s is None and out.final_nprocs == 3
+    assert [c["nprocs"] for c in calls] == [3]
+    assert calls[0]["argv"] == ["worker"]     # no resume token fresh
+
+
+def test_run_transient_kill_restart_with_resume(tmp_path):
+    # incarnation 0 seals step 4 then dies; incarnation 1 finishes
+    results = [_FakeResult([0, -9, 43], quiet=1, first_death_s=0.5),
+               _FakeResult([0, 0, 0])]
+    sup, calls = _supervised(results, tmp_path, seal_after={0: 4})
+    out = sup.run()
+    assert out.ok and out.exit_code == 0
+    assert out.restarts_total == 1 and out.shrink_events == 0
+    inc0, inc1 = out.incarnations
+    assert inc0["victim"] == 1 and inc0["kind"] == "kill"
+    assert inc0["decision"] == "restart" and inc0["progressed"]
+    assert inc0["sealed_step"] == 4
+    assert inc1["decision"] == "done"
+    # relaunch resumed from the sealed commit
+    assert calls[1]["argv"] == ["worker", "resume"]
+    # nothing re-arms the injected fault after recovery
+    sup2, calls2 = _supervised(results, tmp_path,
+                               inject="kill@mid-step:1")
+    sup2.run()
+    assert [c["inject"] for c in calls2] == ["kill@mid-step:1", None]
+
+
+def test_run_mttr_measured_from_death_to_first_step(tmp_path):
+    import time
+    t_rec = time.time() + 3600.0      # "step" event 1h in the future
+    results = [_FakeResult([0, -9], quiet=1, first_death_s=0.0),
+               _FakeResult([0, 0], tails={
+                   0: json.dumps({"evt": "step", "step": 5,
+                                  "t": t_rec}) + "\n"})]
+    sup, _ = _supervised(results, tmp_path, nprocs=2, seal_after={0: 4})
+    out = sup.run()
+    assert out.ok and len(out.mttr_s_all) == 1
+    # death was ~now, recovery stamped 1h later: mttr reflects the gap
+    assert 3500.0 < out.mttr_s < 3700.0
+
+
+def test_run_repeat_offender_shrinks_then_finishes(tmp_path):
+    dead = _FakeResult([0, 43, -9], quiet=2, first_death_s=0.2)
+    results = [dead, dead, _FakeResult([0, 0])]
+    sup, calls = _supervised(results, tmp_path, min_nprocs=2,
+                             hosts="h1:2,h2:1", seal_after={0: 4})
+    out = sup.run()
+    assert out.ok and out.shrink_events == 1 and out.restarts_total == 2
+    assert [r["decision"] for r in out.incarnations] == \
+        ["restart", "shrink", "done"]
+    assert out.final_nprocs == 2
+    assert [c["nprocs"] for c in calls] == [3, 3, 2]
+    assert calls[2]["hosts"] == [("h1", 2)]   # victim slot dropped
+
+
+def test_run_crash_loop_gives_up_44(tmp_path):
+    crash = _FakeResult([3, 43], quiet=None, first_death_s=0.1)
+    sup, _ = _supervised([crash], tmp_path, nprocs=2, max_restarts=5)
+    out = sup.run()
+    assert not out.ok and out.exit_code == GIVEUP_EXIT
+    assert out.gave_up and "crash loop" in out.gave_up
+    assert out.restarts_total == 1        # one relaunch, then the verdict
+    assert sup.counters()["give_ups"] == 1
+
+
+def test_run_budget_exhaustion_gives_up_44(tmp_path):
+    # kills (not crashes) that never seal anything: the budget is the
+    # only thing that ends it
+    kill = _FakeResult([-9, 43], quiet=0, first_death_s=0.1)
+    sup, calls = _supervised([kill], tmp_path, nprocs=2, max_restarts=2,
+                             allow_shrink=False)
+    out = sup.run()
+    assert out.exit_code == GIVEUP_EXIT and "budget" in out.gave_up
+    assert out.restarts_total == 2 and len(calls) == 3
+    assert out.incarnations[-1]["decision"] == "give_up"
+
+
+def test_run_single_crash_with_progress_restarts(tmp_path):
+    # one plain nonzero exit that still sealed a commit is transient
+    # from the budget's point of view: restart, not give-up
+    sup, _ = _supervised([_FakeResult([0, 7], quiet=None,
+                                      first_death_s=0.1),
+                          _FakeResult([0, 0])], tmp_path, nprocs=2,
+                         seal_after={0: 4})
+    out = sup.run()
+    assert out.ok and out.restarts_total == 1
+    assert out.incarnations[0]["victim"] == 1
+    assert out.incarnations[0]["kind"] == "crash"
+
+
+def test_supervisor_requires_exactly_one_workload():
+    with pytest.raises(ValueError):
+        Supervisor()
+    with pytest.raises(ValueError):
+        Supervisor(argv=["x"], source="print()")
+
+
+def test_supervisor_nprocs_from_hosts_env(monkeypatch):
+    monkeypatch.setenv("MXNET_CLUSTER_HOSTS", "a:2,b:2")
+    sup = Supervisor(argv=["x"], stream=False,
+                     launcher_factory=lambda *a: None)
+    assert sup.nprocs == 4 and sup.hosts == "a:2,b:2"
+
+
+# -- real supervised gang (slow tier): shrink + sha identity -----------------
+
+@pytest.mark.slow
+@needs_gloo
+def test_supervised_shrink_sha_identity(tmp_path):
+    """Kill rank 2 twice at N=3 -> the supervisor shrinks to N=2 and the
+    resumed run seals commits whose state_sha256 equals an
+    uninterrupted N=3 baseline at the same steps (the gang-size
+    invariant the elastic trajectory guarantees)."""
+    from mxnet_tpu.cluster import __main__ as cm
+    base = cm.phase_supervised_baseline(3, {})
+    cm.phase_supervised_shrink(3, {}, base)

@@ -58,6 +58,9 @@ case "$stage" in
     echo "== cluster smoke (2-proc gang: barrier, kill injection, resume)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.cluster --selftest --nprocs 2
+    echo "== supervisor smoke (self-healing at N=3: SIGKILL'd rank + coordinator auto-restart, shrink, give-up)"
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+      python -m mxnet_tpu.cluster --selftest --supervise
     echo "== zero smoke (ZeRO-1 bitwise parity, fp8 convergence, HLO wire)"
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
       python -m mxnet_tpu.parallel.zero --selftest

@@ -11,8 +11,17 @@ Supported launchers:
   local  — fork N worker processes on this machine (the reference's
            `--launcher local` used by tests/nightly/dist_sync_kvstore.py)
   manual — print the env each remote worker must export, then run worker 0
+  ssh    — the reference's ssh tracker shape: `-H host1:4,host2:4` or
+           `--hostfile FILE` assigns ranks to hosts in order and runs
+           non-local ranks over passwordless ssh, shipping the DMLC env
+           contract inside the remote command line. Delegates to the
+           supervised `mxnet_tpu.cluster` launcher (log streaming,
+           deadline, failure reaping, flight-recorder postmortems); add
+           `--supervise` for the self-healing auto-restart loop
+           (docs/CLUSTER.md).
 
 Usage: python tools/launch.py -n 4 [--launcher local] python train.py ...
+       python tools/launch.py --launcher ssh -H h1:2,h2:2 python train.py ...
 """
 from __future__ import annotations
 
@@ -62,6 +71,37 @@ def launch_local(num_workers, command):
     return rc
 
 
+def launch_ssh(command, hosts=None, hostfile=None, num_workers=None,
+               supervise=False, checkpoint_dir=None):
+    """Multi-host launch through the mxnet_tpu.cluster seam: the
+    launcher owns rank→host assignment, the coordinator URI (rank 0's
+    host), ssh transport for non-local ranks, log streaming, and
+    failure supervision."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.cluster import launcher as cl
+    if hostfile:
+        spec = cl.read_hostfile(hostfile)
+    elif hosts:
+        spec = cl.parse_host_spec(hosts)
+    else:
+        spec = None             # MXNET_CLUSTER_HOSTS or localhost
+    if supervise:
+        from mxnet_tpu.cluster.supervisor import Supervisor
+        # checkpoint_dir is the supervisor's progress signal: a new
+        # sealed commit between incarnations resets the restart budget
+        out = Supervisor(argv=command, nprocs=num_workers, hosts=spec,
+                         checkpoint_dir=checkpoint_dir).run()
+        print(f"launch: {out.describe()}", file=sys.stderr)
+        return out.exit_code
+    launcher = cl.ClusterLauncher(nprocs=num_workers, hosts=spec)
+    res = launcher.launch(command)
+    print(f"launch: {res.describe()}", file=sys.stderr)
+    if res.ok:
+        return 0
+    return next((rc for rc in res.returncodes if rc not in (0, None)), 1)
+
+
 def launch_manual(num_workers, command, uri, port):
     print("# export on each remote host (rank = 0..n-1):")
     for k, v in worker_env("<rank>", num_workers, uri, port).items():
@@ -75,9 +115,22 @@ def launch_manual(num_workers, command, uri, port):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Launch a distributed mxnet_tpu job")
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
-    ap.add_argument("--launcher", choices=("local", "manual"),
+    ap.add_argument("-n", "--num-workers", type=int, default=None)
+    ap.add_argument("--launcher", choices=("local", "manual", "ssh"),
                     default="local")
+    ap.add_argument("-H", "--hosts",
+                    help="ssh launcher host spec: host1:4,host2:4 "
+                         "(slot total = world size)")
+    ap.add_argument("--hostfile",
+                    help="ssh launcher hostfile: host[:slots] or "
+                         "'host slots=N' per line")
+    ap.add_argument("--supervise", action="store_true",
+                    help="ssh launcher: wrap the gang in the "
+                         "self-healing auto-restart supervisor")
+    ap.add_argument("--checkpoint-dir",
+                    help="where the supervised workload seals commits "
+                         "(the supervisor's progress signal + restart "
+                         "point)")
     ap.add_argument("--host", default="127.0.0.1",
                     help="coordinator host (manual launcher)")
     ap.add_argument("--port", type=int, default=0,
@@ -86,6 +139,16 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    if args.hosts or args.hostfile:
+        args.launcher = "ssh"
+    if args.launcher == "ssh":
+        return launch_ssh(args.command, hosts=args.hosts,
+                          hostfile=args.hostfile,
+                          num_workers=args.num_workers,
+                          supervise=args.supervise,
+                          checkpoint_dir=args.checkpoint_dir)
+    if args.num_workers is None:
+        ap.error("-n/--num-workers is required for this launcher")
     if args.launcher == "local":
         return launch_local(args.num_workers, args.command)
     return launch_manual(args.num_workers, args.command, args.host,
